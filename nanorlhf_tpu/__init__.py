@@ -1,0 +1,17 @@
+"""nanorlhf_tpu — a TPU-native RLHF framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+jackfsuia/nanoRLHF (PPO, GRPO, RLOO, ReMax, REINFORCE, RAFT online RL
+post-training), built TPU-first:
+
+- one HBM-resident sharded param tree serves generation, logprob scoring and
+  training (no vLLM disk round-trip, no CPU offload choreography);
+- rollouts via a jitted autoregressive sampler with KV cache;
+- the six near-identical reference trainers collapse to one runtime plus
+  per-algorithm (sampling_spec, advantage_fn, loss_fn) triples;
+- scaling via jax.sharding.Mesh + pjit/shard_map over ICI, not NCCL.
+
+Reference behavior map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"

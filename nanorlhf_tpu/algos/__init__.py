@@ -1,0 +1,35 @@
+from nanorlhf_tpu.algos.advantages import (
+    grpo_group_advantage,
+    rloo_advantage,
+    remax_advantage,
+    best_of_k_indices,
+    keep_one_of_n_indices,
+    sparse_terminal_rewards,
+    discounted_returns,
+    gae,
+)
+from nanorlhf_tpu.algos.losses import (
+    ppo_clip_loss_token,
+    ppo_clip_loss_sequence,
+    grpo_loss,
+    value_loss_clipped,
+    sft_loss,
+    k3_kl,
+)
+
+__all__ = [
+    "grpo_group_advantage",
+    "rloo_advantage",
+    "remax_advantage",
+    "best_of_k_indices",
+    "keep_one_of_n_indices",
+    "sparse_terminal_rewards",
+    "discounted_returns",
+    "gae",
+    "ppo_clip_loss_token",
+    "ppo_clip_loss_sequence",
+    "grpo_loss",
+    "value_loss_clipped",
+    "sft_loss",
+    "k3_kl",
+]

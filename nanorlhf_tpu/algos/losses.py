@@ -1,0 +1,166 @@
+"""Loss functions for all six algorithms — pure jnp, jit/grad-safe.
+
+Pinned to the per-trainer inlined losses (SURVEY.md §2.4):
+
+- token-level PPO-clip       `/root/reference/GRPO/grpo_trainer.py:655-661`
+- GRPO k3-KL term            `/root/reference/GRPO/grpo_trainer.py:662-672`
+- sequence-level PPO-clip    `/root/reference/RLOO/rloo_trainer.py:660-669`
+- clipped value loss         `/root/reference/PPO/ppo_trainer.py:742-756`
+- RAFT SFT loss              `/root/reference/RAFT/raft_trainer.py:636`
+
+Every function returns `(loss, aux)` where `aux` holds the detached stats the
+reference accumulates per microbatch (`GRPO/grpo_trainer.py:674-689`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from nanorlhf_tpu.ops.masking import masked_mean
+
+
+def _ratio_and_stats(new_logprobs, old_logprobs):
+    """logprob diff / importance ratio shared by all PPO-style losses.
+
+    Padded positions carry INVALID_LOGPROB in both tensors, so their diff is 0
+    and the ratio is exactly 1 there — harmless under the mask, and it keeps
+    the unmasked `approxkl = 0.5 * mean(diff²)` identical to the reference's
+    (`GRPO/grpo_trainer.py:684`).
+    """
+    logprobs_diff = new_logprobs - old_logprobs
+    ratio = jnp.exp(logprobs_diff)
+    approxkl = 0.5 * jnp.mean(logprobs_diff**2)
+    return logprobs_diff, ratio, approxkl
+
+
+def ppo_clip_loss_token(
+    new_logprobs: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    mask: jnp.ndarray,
+    cliprange: float,
+):
+    """Token-level clipped policy-gradient loss (PPO/ReMax/REINFORCE/GRPO core).
+
+    `mask` is True on *real* tokens (the reference passes `~padding_mask`).
+    """
+    _, ratio, approxkl = _ratio_and_stats(new_logprobs, old_logprobs)
+    pg_losses = -advantages * ratio
+    pg_losses2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss_max = jnp.maximum(pg_losses, pg_losses2)
+    loss = masked_mean(pg_loss_max, mask)
+    aux = {
+        "pg_clipfrac": masked_mean((pg_losses2 > pg_losses).astype(jnp.float32), mask),
+        "approxkl": approxkl,
+        "ratio_mean": jnp.mean(ratio),
+        "pg_loss": loss,
+    }
+    return loss, aux
+
+
+def k3_kl(new_logprobs: jnp.ndarray, ref_logprobs: jnp.ndarray) -> jnp.ndarray:
+    """k3 KL estimator: e^{-kl} + kl - 1 where kl = logπ - logπ_ref.
+
+    Always ≥ 0; the GRPO in-loss KL penalty (`GRPO/grpo_trainer.py:667-670`).
+    """
+    kl = new_logprobs - ref_logprobs
+    return jnp.exp(-kl) + kl - 1.0
+
+
+def grpo_loss(
+    new_logprobs: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    ref_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    mask: jnp.ndarray,
+    cliprange: float,
+    kl_coef: float,
+):
+    """GRPO = token-level PPO-clip + kl_coef · k3-KL, jointly masked-meaned.
+
+    (`GRPO/grpo_trainer.py:662-672` — note the KL term sits *inside* the
+    masked mean with the clipped PG term.)
+    """
+    _, ratio, approxkl = _ratio_and_stats(new_logprobs, old_logprobs)
+    pg_losses = -advantages * ratio
+    pg_losses2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    kl = new_logprobs - ref_logprobs
+    kl_term = kl_coef * k3_kl(new_logprobs, ref_logprobs)
+    pg_loss_max = jnp.maximum(pg_losses, pg_losses2) + kl_term
+    loss = masked_mean(pg_loss_max, mask)
+    aux = {
+        "pg_clipfrac": masked_mean((pg_losses2 > pg_losses).astype(jnp.float32), mask),
+        "approxkl": approxkl,
+        "ratio_mean": jnp.mean(ratio),
+        "refkl_mean": jnp.mean(kl),
+        "pg_loss": loss,
+    }
+    return loss, aux
+
+
+def ppo_clip_loss_sequence(
+    new_logprobs: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    mask: jnp.ndarray,
+    cliprange: float,
+):
+    """Sequence-level PPO-clip (RLOO): ratio of summed logprobs, plain mean.
+
+    The reference sums the INVALID_LOGPROB-filled tensors directly
+    (`RLOO/rloo_trainer.py:660-662`); the pad contributions cancel in the
+    diff, so masking before the sum is exactly equivalent.
+    `advantages` is sequence-level, shape [B].
+    """
+    mask_f = mask.astype(new_logprobs.dtype)
+    new_sum = jnp.sum(new_logprobs * mask_f, axis=1)
+    old_sum = jnp.sum(old_logprobs * mask_f, axis=1)
+    logprobs_diff = new_sum - old_sum
+    ratio = jnp.exp(logprobs_diff)
+    pg_losses = -advantages * ratio
+    pg_losses2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss_max = jnp.maximum(pg_losses, pg_losses2)
+    loss = jnp.mean(pg_loss_max)
+    aux = {
+        "pg_clipfrac": jnp.mean((pg_losses2 > pg_losses).astype(jnp.float32)),
+        "approxkl": 0.5 * jnp.mean(logprobs_diff**2),
+        "ratio_mean": jnp.mean(ratio),
+        "pg_loss": loss,
+    }
+    return loss, aux
+
+
+def value_loss_clipped(
+    vpred: jnp.ndarray,
+    values: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask_p1: jnp.ndarray,
+    cliprange_value: float,
+):
+    """PPO clipped value loss: 0.5 · masked_mean(max((v-R)², (v_clip-R)²)).
+
+    `mask_p1` is True on valid value positions (~padding_mask_p1).
+    (`PPO/ppo_trainer.py:742-748`.)
+    """
+    vpredclipped = jnp.clip(vpred, values - cliprange_value, values + cliprange_value)
+    vf_losses1 = jnp.square(vpred - returns)
+    vf_losses2 = jnp.square(vpredclipped - returns)
+    vf_loss_max = jnp.maximum(vf_losses1, vf_losses2)
+    loss = 0.5 * masked_mean(vf_loss_max, mask_p1)
+    aux = {
+        "vf_loss": loss,
+        "vf_clipfrac": masked_mean((vf_losses2 > vf_losses1).astype(jnp.float32), mask_p1),
+    }
+    return loss, aux
+
+
+def sft_loss(new_logprobs: jnp.ndarray, mask: jnp.ndarray):
+    """RAFT: negative summed logprob of the kept sample, mean over batch.
+
+    The reference sums the INVALID_LOGPROB-filled tensor
+    (`RAFT/raft_trainer.py:636`), adding a gradient-free -1·n_pad constant per
+    row; we mask before summing — identical gradients, cleaner loss value.
+    """
+    mask_f = mask.astype(new_logprobs.dtype)
+    loss = -jnp.mean(jnp.sum(new_logprobs * mask_f, axis=1))
+    return loss, {"pg_loss": loss}

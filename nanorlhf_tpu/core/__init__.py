@@ -1,0 +1,19 @@
+from nanorlhf_tpu.core.config import ModelConfig
+from nanorlhf_tpu.core.model import (
+    init_params,
+    model_forward,
+    padded_forward_logits,
+    prefill,
+    decode_step,
+    init_kv_cache,
+)
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "model_forward",
+    "padded_forward_logits",
+    "prefill",
+    "decode_step",
+    "init_kv_cache",
+]

@@ -1,0 +1,95 @@
+"""Model architecture config (Qwen2-family decoder).
+
+The reference loads policies with `AutoModelForCausalLM` (Qwen2.5 models,
+`/root/reference/GRPO/grpo.py:218-224`); this dataclass captures the Qwen2
+architecture hyperparameters our JAX decoder needs. Presets mirror the HF
+configs of the model sizes the reference trains (0.5B/1.5B/7B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 1536
+    intermediate_size: int = 8960
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 12
+    num_key_value_heads: int = 2
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_attention_heads
+    rope_theta: float = 1_000_000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    max_position_embeddings: int = 32768
+
+    @property
+    def actual_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_kv_groups(self) -> int:
+        return self.num_attention_heads // self.num_key_value_heads
+
+    @classmethod
+    def qwen2_tiny(cls, vocab_size: int = 512) -> "ModelConfig":
+        """Test-size model: runs fast on the CPU test mesh."""
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            rope_theta=10_000.0,
+            max_position_embeddings=1024,
+        )
+
+    @classmethod
+    def qwen2_0_5b(cls) -> "ModelConfig":
+        return cls(
+            hidden_size=896,
+            intermediate_size=4864,
+            num_hidden_layers=24,
+            num_attention_heads=14,
+            num_key_value_heads=2,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def qwen2_1_5b(cls) -> "ModelConfig":
+        return cls()  # defaults are Qwen2.5-1.5B
+
+    @classmethod
+    def qwen2_7b(cls) -> "ModelConfig":
+        return cls(
+            hidden_size=3584,
+            intermediate_size=18944,
+            num_hidden_layers=28,
+            num_attention_heads=28,
+            num_key_value_heads=4,
+            tie_word_embeddings=False,
+        )
+
+    @classmethod
+    def from_hf_config(cls, hf_config) -> "ModelConfig":
+        """Build from a `transformers` Qwen2Config (or dict)."""
+        get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
+            hf_config, dict
+        ) else (lambda k, d=None: hf_config.get(k, d))
+        return cls(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("hidden_size"),
+            intermediate_size=get("intermediate_size"),
+            num_hidden_layers=get("num_hidden_layers"),
+            num_attention_heads=get("num_attention_heads"),
+            num_key_value_heads=get("num_key_value_heads"),
+            head_dim=get("head_dim", None),
+            rope_theta=get("rope_theta", 1_000_000.0),
+            rms_norm_eps=get("rms_norm_eps", 1e-6),
+            tie_word_embeddings=get("tie_word_embeddings", False),
+            max_position_embeddings=get("max_position_embeddings", 32768),
+        )

@@ -1,0 +1,303 @@
+"""Qwen2-family decoder as pure functions over a stacked-layer pytree.
+
+TPU-first design choices (vs the reference's HF `AutoModelForCausalLM`,
+`/root/reference/GRPO/grpo.py:218-224`):
+
+- **Stacked layers + `lax.scan`**: all per-layer weights are stacked along a
+  leading [L, ...] axis and the decoder runs one traced layer body L times.
+  One compilation regardless of depth; XLA pipelines the scan body.
+- **Pure pytrees**: params are a nested dict of jnp arrays — the same tree is
+  sharded once over the mesh and shared by rollout, logprob scoring and the
+  train step (this kills the reference's CPU↔GPU offload + disk→vLLM handoff,
+  `GRPO/grpo_trainer.py:122-166,475-476`).
+- **bf16 params, f32 softmax/norms**: matmuls hit the MXU in bf16; softmax,
+  RMSNorm statistics and rotary tables run in f32 for stability.
+- **GQA without materializing repeated KV**: queries are reshaped to
+  [B, KV, G, T, hd] and contracted against unrepeated KV heads.
+
+The padding-robust entrypoint `padded_forward_logits` reproduces the contract
+of the reference's shared `forward()` helper (`GRPO/grpo_trainer.py:90-120`):
+mask = (ids != pad), positions = cumsum(mask)-mask, padded ids zeroed.
+
+Weight layout: all projection matrices are stored [in, out] (x @ W), i.e. the
+transpose of torch `nn.Linear.weight`; the HF loader transposes on load.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core.config import ModelConfig
+
+NEG_INF = -2.0**30  # large-but-finite mask value; -inf breaks softmax rows that are fully masked
+
+
+# ---------------------------------------------------------------------------
+# Parameter construction
+# ---------------------------------------------------------------------------
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random-init a full parameter tree (tests / from-scratch training)."""
+    hd = config.actual_head_dim
+    D, F, V = config.hidden_size, config.intermediate_size, config.vocab_size
+    H, KV, L = config.num_attention_heads, config.num_key_value_heads, config.num_hidden_layers
+
+    keys = iter(jax.random.split(key, 16))
+
+    def dense(k, shape, scale=None):
+        scale = scale if scale is not None else (1.0 / jnp.sqrt(shape[0]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    def stacked(k, shape, scale=None):
+        return dense(k, (L,) + shape, scale)
+
+    params = {
+        "embed_tokens": dense(next(keys), (V, D), scale=0.02),
+        "layers": {
+            "input_layernorm": jnp.ones((L, D), dtype),
+            "q_proj": {"kernel": stacked(next(keys), (D, H * hd)),
+                       "bias": jnp.zeros((L, H * hd), dtype)},
+            "k_proj": {"kernel": stacked(next(keys), (D, KV * hd)),
+                       "bias": jnp.zeros((L, KV * hd), dtype)},
+            "v_proj": {"kernel": stacked(next(keys), (D, KV * hd)),
+                       "bias": jnp.zeros((L, KV * hd), dtype)},
+            "o_proj": {"kernel": stacked(next(keys), (H * hd, D))},
+            "post_attention_layernorm": jnp.ones((L, D), dtype),
+            "gate_proj": {"kernel": stacked(next(keys), (D, F))},
+            "up_proj": {"kernel": stacked(next(keys), (D, F))},
+            "down_proj": {"kernel": stacked(next(keys), (F, D))},
+        },
+        "norm": jnp.ones((D,), dtype),
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = dense(next(keys), (D, V), scale=0.02)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(positions: jnp.ndarray, head_dim: int, theta: float):
+    """cos/sin tables [B, T, hd] for the given absolute positions (f32)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, hd/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # HF rotate_half layout
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, T, hd]; cos/sin: [B, T, hd] (HF rotate-half convention)."""
+    cos = cos[:, None, :, :]
+    sin = sin[:, None, :, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    xf = x.astype(jnp.float32)
+    rf = rotated.astype(jnp.float32)
+    return (xf * cos + rf * sin).astype(x.dtype)
+
+
+def gqa_attention(
+    q: jnp.ndarray,       # [B, H, Tq, hd]
+    k: jnp.ndarray,       # [B, KV, Tk, hd]
+    v: jnp.ndarray,       # [B, KV, Tk, hd]
+    mask: jnp.ndarray,    # [B, 1, Tq, Tk] bool, True = attend
+) -> jnp.ndarray:
+    B, H, Tq, hd = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    qg = q.reshape(B, KV, G, Tq, hd)
+    scores = jnp.einsum("bkgqh,bkth->bkgqt", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    scores = jnp.where(mask[:, :, None, :, :], scores, NEG_INF)  # [B,1,1,Tq,Tk] broadcast
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqt,bkth->bkgqh", probs, v)
+    return out.reshape(B, H, Tq, hd)
+
+
+# ---------------------------------------------------------------------------
+# Layer body (scanned)
+# ---------------------------------------------------------------------------
+
+def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache, cache_index):
+    """One decoder layer. If kv_cache is not None, operate incrementally.
+
+    Returns (x_out, new_kv_pair_or_None).
+    kv_cache: (k_cache, v_cache) each [B, KV, T_max, hd] or None.
+    """
+    hd = config.actual_head_dim
+    H, KV = config.num_attention_heads, config.num_key_value_heads
+    B, T, D = x.shape
+
+    h = rms_norm(x, layer_params["input_layernorm"], config.rms_norm_eps)
+    q = h @ layer_params["q_proj"]["kernel"] + layer_params["q_proj"]["bias"]
+    k = h @ layer_params["k_proj"]["kernel"] + layer_params["k_proj"]["bias"]
+    v = h @ layer_params["v_proj"]["kernel"] + layer_params["v_proj"]["bias"]
+    q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
+
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if kv_cache is not None:
+        k_cache, v_cache = kv_cache
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cache_index, 0))
+        attn_k, attn_v = k_cache, v_cache
+        new_cache = (k_cache, v_cache)
+    else:
+        attn_k, attn_v = k, v
+        new_cache = None
+
+    out = gqa_attention(q, attn_k, attn_v, mask)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+    out = out @ layer_params["o_proj"]["kernel"]
+    x = x + out
+
+    h = rms_norm(x, layer_params["post_attention_layernorm"], config.rms_norm_eps)
+    gate = h @ layer_params["gate_proj"]["kernel"]
+    up = h @ layer_params["up_proj"]["kernel"]
+    ff = (jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up) @ layer_params[
+        "down_proj"
+    ]["kernel"]
+    x = x + ff
+    return x, new_cache
+
+
+def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0):
+    """Scan the stacked layer params over the layer body."""
+    if kv_caches is None:
+        def body(carry, layer_params):
+            y, _ = _layer_body(config, carry, layer_params, cos, sin, mask, None, 0)
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        return x, None
+    else:
+        def body(carry, inp):
+            layer_params, k_cache, v_cache = inp
+            y, new_cache = _layer_body(
+                config, carry, layer_params, cos, sin, mask, (k_cache, v_cache), cache_index
+            )
+            return y, new_cache
+
+        x, new_caches = jax.lax.scan(body, x, (params["layers"], kv_caches[0], kv_caches[1]))
+        return x, new_caches
+
+
+def _logits(config: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["norm"], config.rms_norm_eps)
+    if config.tie_word_embeddings:
+        return x @ params["embed_tokens"].T
+    return x @ params["lm_head"]
+
+
+# ---------------------------------------------------------------------------
+# Public entrypoints
+# ---------------------------------------------------------------------------
+
+def model_forward(
+    params: dict,
+    config: ModelConfig,
+    input_ids: jnp.ndarray,       # [B, T]
+    attention_mask: jnp.ndarray,  # [B, T] bool/int, True = real token
+    position_ids: jnp.ndarray,    # [B, T]
+) -> jnp.ndarray:
+    """Full-sequence forward (training / logprob pass). Returns logits [B, T, V]."""
+    x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
+    B, T = input_ids.shape
+    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    mask = causal[None, None, :, :] & (attention_mask.astype(bool))[:, None, None, :]
+    x, _ = _run_layers(config, params, x, cos, sin, mask)
+    return _logits(config, params, x)
+
+
+def padded_forward_logits(
+    params: dict, config: ModelConfig, query_responses: jnp.ndarray, pad_token_id: int
+) -> jnp.ndarray:
+    """Padding-robust forward: the reference's shared `forward()` contract.
+
+    attention_mask = (ids != pad); position_ids = cumsum(mask) - mask; padded
+    ids replaced with 0 (`/root/reference/GRPO/grpo_trainer.py:90-120`).
+    """
+    attention_mask = query_responses != pad_token_id
+    position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
+    input_ids = jnp.where(attention_mask, query_responses, 0)
+    return model_forward(params, config, input_ids, attention_mask, position_ids)
+
+
+def init_kv_cache(
+    config: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Stacked KV cache: (k, v), each [L, B, KV, max_len, hd]."""
+    shape = (
+        config.num_hidden_layers,
+        batch,
+        config.num_key_value_heads,
+        max_len,
+        config.actual_head_dim,
+    )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def prefill(
+    params: dict,
+    config: ModelConfig,
+    input_ids: jnp.ndarray,       # [B, T_prompt]
+    attention_mask: jnp.ndarray,  # [B, T_prompt]
+    kv_caches: tuple[jnp.ndarray, jnp.ndarray],  # from init_kv_cache, T_max >= T_prompt
+):
+    """Prompt ingestion: fills the KV cache, returns (last-position logits, caches).
+
+    Prompts are assumed *left-padded* to a common length (sampler contract), so
+    the last position is the last prompt token for every row.
+    """
+    B, T = input_ids.shape
+    T_max = kv_caches[0].shape[3]
+    attention_mask = attention_mask.astype(bool)
+    position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
+    x = params["embed_tokens"][jnp.where(attention_mask, input_ids, 0)].astype(
+        params["embed_tokens"].dtype
+    )
+    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    # queries attend over cache positions [0, T); the rest of T_max is masked
+    mask = (causal[None, None, :, :] & attention_mask[:, None, None, :])
+    mask_full = jnp.zeros((B, 1, T, T_max), bool).at[:, :, :, :T].set(mask)
+    x, new_caches = _run_layers(
+        config, params, x, cos, sin, mask_full, kv_caches=kv_caches, cache_index=0
+    )
+    logits = _logits(config, params, x[:, -1:, :])[:, 0, :]
+    return logits, new_caches
+
+
+def decode_step(
+    params: dict,
+    config: ModelConfig,
+    token: jnp.ndarray,           # [B] current token
+    position: jnp.ndarray,        # [B] its absolute position id
+    cache_index,                  # scalar: slot to write KV into
+    key_mask: jnp.ndarray,        # [B, T_max] bool: which cache slots are valid (incl. this one)
+    kv_caches: tuple[jnp.ndarray, jnp.ndarray],
+):
+    """One autoregressive decode step. Returns (logits [B, V], new caches)."""
+    B = token.shape[0]
+    x = params["embed_tokens"][token][:, None, :].astype(params["embed_tokens"].dtype)
+    cos, sin = rope_tables(position[:, None], config.actual_head_dim, config.rope_theta)
+    mask = key_mask[:, None, None, :]  # [B, 1, 1, T_max]
+    x, new_caches = _run_layers(
+        config, params, x, cos, sin, mask, kv_caches=kv_caches, cache_index=cache_index
+    )
+    logits = _logits(config, params, x)[:, 0, :]
+    return logits, new_caches

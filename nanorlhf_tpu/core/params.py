@@ -1,0 +1,113 @@
+"""HF checkpoint → stacked JAX param tree.
+
+The reference gets weights via `AutoModelForCausalLM.from_pretrained`
+(`/root/reference/GRPO/grpo.py:218-224`). Here we map the HF Qwen2 state-dict
+layout onto our scan-friendly stacked tree (core/model.py): per-layer tensors
+are stacked along a leading [L, ...] axis and torch `nn.Linear` weights
+([out, in]) are transposed to the x @ W layout ([in, out]).
+
+Weight fidelity (GQA head layout, tied embeddings, RoPE) is pinned by
+tests/test_model_parity.py against the torch Qwen2 implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.core.config import ModelConfig
+
+_LINEAR_KEYS = (
+    ("q_proj", "self_attn.q_proj", True),
+    ("k_proj", "self_attn.k_proj", True),
+    ("v_proj", "self_attn.v_proj", True),
+    ("o_proj", "self_attn.o_proj", False),
+    ("gate_proj", "mlp.gate_proj", False),
+    ("up_proj", "mlp.up_proj", False),
+    ("down_proj", "mlp.down_proj", False),
+)
+
+
+def _to_np(t) -> np.ndarray:
+    """torch tensor / np array → np array (bf16-safe via float32 round-trip)."""
+    if hasattr(t, "detach"):
+        t = t.detach()
+        if t.dtype.is_floating_point:
+            t = t.float()
+        t = t.cpu().numpy()
+    return np.asarray(t)
+
+
+def params_from_hf_state_dict(
+    config: ModelConfig, state_dict: dict, dtype=jnp.bfloat16
+) -> dict:
+    """Convert an HF Qwen2ForCausalLM state dict (name → tensor) to our tree."""
+    sd = {k: _to_np(v) for k, v in state_dict.items()}
+    L = config.num_hidden_layers
+
+    def cast(x):
+        return jnp.asarray(x, dtype)
+
+    layers: dict = {
+        "input_layernorm": cast(
+            np.stack([sd[f"model.layers.{i}.input_layernorm.weight"] for i in range(L)])
+        ),
+        "post_attention_layernorm": cast(
+            np.stack(
+                [sd[f"model.layers.{i}.post_attention_layernorm.weight"] for i in range(L)]
+            )
+        ),
+    }
+    for ours, theirs, has_bias in _LINEAR_KEYS:
+        kernel = np.stack(
+            [sd[f"model.layers.{i}.{theirs}.weight"].T for i in range(L)]
+        )
+        entry = {"kernel": cast(kernel)}
+        if has_bias:
+            entry["bias"] = cast(
+                np.stack([sd[f"model.layers.{i}.{theirs}.bias"] for i in range(L)])
+            )
+        layers[ours] = entry
+
+    params = {
+        "embed_tokens": cast(sd["model.embed_tokens.weight"]),
+        "layers": layers,
+        "norm": cast(sd["model.norm.weight"]),
+    }
+    if not config.tie_word_embeddings:
+        # some HF checkpoints omit lm_head when tied; require it when untied
+        params["lm_head"] = cast(sd["lm_head.weight"].T)
+    return params
+
+
+def load_hf_checkpoint(model_dir: str, dtype=jnp.bfloat16):
+    """Load (ModelConfig, params) from an HF model directory on disk.
+
+    Reads config.json + *.safetensors (or pytorch_model.bin fallback).
+    Host-side, outside the compiled graph — like the reference's tokenizer/
+    checkpoint IO.
+    """
+    with open(os.path.join(model_dir, "config.json")) as f:
+        config = ModelConfig.from_hf_config(json.load(f))
+
+    state_dict: dict = {}
+    st_files = sorted(
+        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for fname in st_files:
+            with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+                for k in f.keys():
+                    state_dict[k] = f.get_tensor(k)
+    else:
+        import torch
+
+        state_dict = torch.load(
+            os.path.join(model_dir, "pytorch_model.bin"), map_location="cpu"
+        )
+    return config, params_from_hf_state_dict(config, state_dict, dtype)

@@ -1,0 +1,25 @@
+from nanorlhf_tpu.ops.masking import (
+    INVALID_LOGPROB,
+    exact_div,
+    first_true_indices,
+    truncate_response,
+    masked_mean,
+    masked_var,
+    masked_whiten,
+    response_padding_masks,
+    logprobs_from_logits,
+    entropy_from_logits,
+)
+
+__all__ = [
+    "INVALID_LOGPROB",
+    "exact_div",
+    "first_true_indices",
+    "truncate_response",
+    "masked_mean",
+    "masked_var",
+    "masked_whiten",
+    "response_padding_masks",
+    "logprobs_from_logits",
+    "entropy_from_logits",
+]

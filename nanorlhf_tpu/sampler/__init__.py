@@ -1,0 +1,3 @@
+from nanorlhf_tpu.sampler.sampler import SamplingParams, generate, generate_tokens
+
+__all__ = ["SamplingParams", "generate", "generate_tokens"]

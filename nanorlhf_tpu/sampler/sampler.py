@@ -1,0 +1,158 @@
+"""Jitted autoregressive sampler — the TPU-native replacement for vLLM rollouts.
+
+The reference hands weights to vLLM through a disk round-trip every update
+(`/root/reference/GRPO/grpo_trainer.py:122-166`): model→CPU, (merge LoRA),
+save_pretrained, rebuild an `LLM` engine, generate, delete engine, model→GPU.
+On TPU the policy params already live sharded in HBM, so generation is just
+another jitted function over the same tree — the entire handoff disappears.
+
+Output contract is identical to `vllm_generate` (`grpo_trainer.py:152-160`):
+`[B*N, max_tokens]` int32, N consecutive samples per prompt (prompt-major),
+each row = generated tokens including the terminating EOS, right-padded with
+`pad_token_id`. Capability parity with `SamplingParams(temperature, top_p=0.95,
+n=N, seed=randint)` (`grpo_trainer.py:127`) — the per-call changing seed
+becomes a per-call PRNG key. Greedy mode covers the ReMax baseline rollout
+(`ReMax/remax_trainer.py:166-185`) and the r1 accuracy eval
+(`examples/r1-v0/grpo_r1.py:291-318`).
+
+Decode is a `lax.while_loop` over single-token steps with a shared KV cache;
+it exits early once every sequence has emitted EOS (rollouts are offline-batch,
+so big batches keep the MXU busy; early exit claws back the static-shape tax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core.config import ModelConfig
+from nanorlhf_tpu.core.model import decode_step, init_kv_cache, prefill
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 0.95
+    n: int = 1
+    max_tokens: int = 256
+    greedy: bool = False
+
+
+def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
+    """Mask logits outside the top-p nucleus (smallest set with cum prob ≥ p)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # keep tokens whose *exclusive* cumulative prob is < top_p (first always kept)
+    keep_sorted = (cum - sorted_probs) < top_p
+    # threshold = smallest kept logit
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= threshold, logits, -jnp.inf)
+
+
+def _sample_token(key, logits, temperature, top_p, greedy):
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_p < 1.0:
+        logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
+                     "temperature", "top_p", "greedy"),
+)
+def generate_tokens(
+    params: dict,
+    config: ModelConfig,
+    prompt_ids: jnp.ndarray,    # [B, Tp] left-padded
+    prompt_mask: jnp.ndarray,   # [B, Tp] bool
+    key: jax.Array,
+    *,
+    max_tokens: int,
+    eos_token_id: int,
+    pad_token_id: int,
+    temperature: float = 1.0,
+    top_p: float = 0.95,
+    greedy: bool = False,
+) -> jnp.ndarray:
+    """Core jitted loop: one sample per row. Returns [B, max_tokens] int32."""
+    B, Tp = prompt_ids.shape
+    T_max = Tp + max_tokens
+    prompt_mask = prompt_mask.astype(bool)
+    dtype = params["embed_tokens"].dtype
+
+    caches = init_kv_cache(config, B, T_max, dtype)
+    first_logits, caches = prefill(params, config, prompt_ids, prompt_mask, caches)
+
+    prompt_len = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # real prompt length
+    key_mask0 = jnp.zeros((B, T_max), bool).at[:, :Tp].set(prompt_mask)
+
+    out0 = jnp.full((B, max_tokens), pad_token_id, jnp.int32)
+    key, k0 = jax.random.split(key)
+    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy)
+    out0 = out0.at[:, 0].set(tok0)
+    done0 = tok0 == eos_token_id
+
+    def cond(state):
+        step, _, _, _, done, _, _ = state
+        return (step < max_tokens) & ~jnp.all(done)
+
+    def body(state):
+        step, out, caches, key_mask, done, cur_tok, key = state
+        # write current token's KV at cache slot Tp + step - 1 ... wait: token t
+        # sampled from logits at position prompt_len + step - 1; feed it in now.
+        cache_slot = Tp + step - 1
+        key_mask = key_mask.at[:, cache_slot].set(True)  # current slot becomes visible
+        position = prompt_len + step - 1
+        logits, caches = decode_step(
+            params, config, cur_tok, position, cache_slot, key_mask, caches
+        )
+        key, k = jax.random.split(key)
+        tok = _sample_token(k, logits, temperature, top_p, greedy)
+        tok = jnp.where(done, pad_token_id, tok)
+        out = jnp.where(
+            (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None], tok[:, None], out
+        )
+        done = done | (tok == eos_token_id)
+        return step + 1, out, caches, key_mask, done, tok, key
+
+    state = (jnp.int32(1), out0, caches, key_mask0, done0, tok0, key)
+    _, out, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
+    return out
+
+
+def generate(
+    params: dict,
+    config: ModelConfig,
+    prompt_ids: jnp.ndarray,
+    prompt_mask: jnp.ndarray,
+    key: jax.Array,
+    sampling: SamplingParams,
+    eos_token_id: int,
+    pad_token_id: int,
+) -> jnp.ndarray:
+    """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per prompt."""
+    if sampling.n > 1:
+        prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
+        prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
+    return generate_tokens(
+        params,
+        config,
+        prompt_ids,
+        prompt_mask,
+        key,
+        max_tokens=sampling.max_tokens,
+        eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id,
+        temperature=sampling.temperature,
+        top_p=sampling.top_p,
+        greedy=sampling.greedy,
+    )

@@ -1,0 +1,28 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Must set XLA flags before jax initializes its backend, hence module-level env
+mutation in conftest (pytest imports this before any test module).
+"""
+
+import os
+
+# Force CPU even if the environment pins another platform (e.g. a tunneled
+# TPU): unit/sharding tests must run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,123 @@
+"""Advantage estimators vs torch oracles restating the reference formulas."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from nanorlhf_tpu.algos import (
+    grpo_group_advantage,
+    rloo_advantage,
+    remax_advantage,
+    best_of_k_indices,
+    keep_one_of_n_indices,
+    sparse_terminal_rewards,
+    discounted_returns,
+    gae,
+)
+
+
+def test_grpo_group_advantage(rng):
+    B, N = 5, 4
+    scores = rng.normal(size=(B * N,)).astype(np.float32)
+    got = np.asarray(grpo_group_advantage(jnp.asarray(scores), N))
+    t = torch.from_numpy(scores).view(B, N)
+    want = (t - t.mean(dim=1, keepdim=True)) / t.std(dim=1, keepdim=True)
+    np.testing.assert_allclose(got, want.reshape(-1).numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_grpo_zero_variance_group_maps_nan_to_zero():
+    scores = jnp.array([2.0, 2.0, 2.0, 2.0, 1.0, 0.0, 1.0, 0.0])
+    got = np.asarray(grpo_group_advantage(scores, 4))
+    assert np.all(np.isfinite(got))
+    np.testing.assert_array_equal(got[:4], 0.0)
+
+
+def test_rloo_advantage(rng):
+    B, N = 3, 4
+    r = rng.normal(size=(B * N,)).astype(np.float32)
+    got = np.asarray(rloo_advantage(jnp.asarray(r), N))
+    t = torch.from_numpy(r).view(B, N)
+    baseline = (t.sum(dim=1, keepdim=True) - t) / (N - 1)
+    np.testing.assert_allclose(got, (t - baseline).reshape(-1).numpy(), rtol=1e-4)
+
+
+def test_remax_advantage():
+    s = jnp.array([1.0, 2.0, 3.0])
+    b = jnp.array([0.5, 2.5, 3.0])
+    np.testing.assert_allclose(np.asarray(remax_advantage(s, b)), [0.5, -0.5, 0.0])
+
+
+def test_best_of_k():
+    r = jnp.array([1.0, 5.0, 2.0, 0.0, 7.0, 3.0, 1.0, 2.0])
+    np.testing.assert_array_equal(np.asarray(best_of_k_indices(r, 4)), [1, 0])
+    rand = best_of_k_indices(r, 4, key=jax.random.PRNGKey(0))
+    assert rand.shape == (2,) and bool(jnp.all(rand >= 0)) and bool(jnp.all(rand < 4))
+
+
+def test_keep_one_of_n_range():
+    idx = keep_one_of_n_indices(jax.random.PRNGKey(1), 100, 4)
+    assert idx.shape == (100,)
+    assert set(np.unique(np.asarray(idx))) <= {0, 1, 2, 3}
+
+
+def test_sparse_terminal_rewards_placement():
+    scores = jnp.array([10.0, 20.0])
+    # row 0: seq ends at 2, position 3 exists -> score at 3
+    # row 1: seq ends at 4 (last index of length-5 response) -> score at 4
+    seq_len = jnp.array([2, 4])
+    got = np.asarray(sparse_terminal_rewards(scores, seq_len, 5))
+    want = np.zeros((2, 5), np.float32)
+    want[0, 3] = 10.0
+    want[1, 4] = 20.0
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sparse_terminal_rewards_with_kl(rng):
+    kl_pen = rng.normal(size=(2, 5)).astype(np.float32)
+    scores = jnp.array([1.0, -1.0])
+    seq_len = jnp.array([0, 3])
+    got = np.asarray(sparse_terminal_rewards(scores, seq_len, 5, jnp.asarray(kl_pen)))
+    want = kl_pen.copy()
+    want[0, 1] += 1.0
+    want[1, 4] += -1.0
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def _torch_discounted(rewards, gamma):
+    lastgaelam = torch.zeros(rewards.shape[0])
+    out = []
+    for t in reversed(range(rewards.shape[1])):
+        lastgaelam = rewards[:, t] + gamma * lastgaelam
+        out.append(lastgaelam)
+    return torch.stack(out[::-1], axis=1)
+
+
+def test_discounted_returns(rng):
+    r = rng.normal(size=(4, 9)).astype(np.float32)
+    for gamma in (1.0, 0.95):
+        got = np.asarray(discounted_returns(jnp.asarray(r), gamma))
+        want = _torch_discounted(torch.from_numpy(r), gamma)
+        np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_gae_matches_reference_loop(rng):
+    B, T = 3, 7
+    rewards = rng.normal(size=(B, T)).astype(np.float32)
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    gamma, lam = 1.0, 0.95
+    adv, ret = gae(jnp.asarray(rewards), jnp.asarray(values), gamma, lam)
+
+    tr, tv = torch.from_numpy(rewards), torch.from_numpy(values)
+    lastgaelam = torch.zeros(B)
+    rev = []
+    for t in reversed(range(T)):
+        nextvalues = tv[:, t + 1] if t < T - 1 else torch.zeros(B)
+        delta = tr[:, t] + gamma * nextvalues - tv[:, t]
+        lastgaelam = delta + gamma * lam * lastgaelam
+        rev.append(lastgaelam)
+    want_adv = torch.stack(rev[::-1], axis=1)
+    np.testing.assert_allclose(np.asarray(adv), want_adv.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ret), (want_adv + tv).numpy(), rtol=1e-4, atol=1e-5
+    )

@@ -1,0 +1,135 @@
+"""Loss functions vs torch oracles restating the reference loss blocks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from nanorlhf_tpu.algos import (
+    ppo_clip_loss_token,
+    ppo_clip_loss_sequence,
+    grpo_loss,
+    value_loss_clipped,
+    sft_loss,
+    k3_kl,
+)
+from nanorlhf_tpu.ops import INVALID_LOGPROB
+
+
+def make_batch(rng, B=4, T=6):
+    new = -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+    old = -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+    ref = -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+    seq_len = rng.integers(1, T, size=(B,))
+    pad = np.arange(T)[None, :] > seq_len[:, None]
+    # reference masked_fills pads with INVALID_LOGPROB in new/old/ref alike
+    new[pad] = INVALID_LOGPROB
+    old[pad] = INVALID_LOGPROB
+    ref[pad] = INVALID_LOGPROB
+    adv = rng.normal(size=(B, T)).astype(np.float32)
+    adv[pad] = 0.0
+    return new, old, ref, adv, pad
+
+
+def torch_masked_mean(v, m):
+    return (v * m).sum() / m.sum()
+
+
+def test_ppo_clip_loss_token(rng):
+    new, old, ref, adv, pad = make_batch(rng)
+    cliprange = 0.2
+    loss, aux = ppo_clip_loss_token(
+        jnp.asarray(new), jnp.asarray(old), jnp.asarray(adv), jnp.asarray(~pad), cliprange
+    )
+    tn, to, ta, tm = map(torch.from_numpy, (new, old, adv, ~pad))
+    diff = tn - to
+    ratio = torch.exp(diff)
+    pg1 = -ta * ratio
+    pg2 = -ta * torch.clamp(ratio, 1 - cliprange, 1 + cliprange)
+    want = torch_masked_mean(torch.max(pg1, pg2), tm)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(aux["approxkl"]), float(0.5 * (diff**2).mean()), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        float(aux["pg_clipfrac"]), float(torch_masked_mean((pg2 > pg1).float(), tm)), rtol=1e-4
+    )
+
+
+def test_grpo_loss(rng):
+    new, old, ref, adv, pad = make_batch(rng)
+    cliprange, kl_coef = 0.2, 0.04
+    loss, aux = grpo_loss(
+        jnp.asarray(new), jnp.asarray(old), jnp.asarray(ref), jnp.asarray(adv),
+        jnp.asarray(~pad), cliprange, kl_coef,
+    )
+    tn, to, tr, ta, tm = map(torch.from_numpy, (new, old, ref, adv, ~pad))
+    ratio = torch.exp(tn - to)
+    pg1 = -ta * ratio
+    pg2 = -ta * torch.clamp(ratio, 1 - cliprange, 1 + cliprange)
+    kl = tn - tr
+    kl_term = kl_coef * (torch.exp(-kl) + kl - 1)
+    want = torch_masked_mean(torch.max(pg1, pg2) + kl_term, tm)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+
+
+def test_k3_kl_nonnegative(rng):
+    a = jnp.asarray(rng.normal(size=(10,)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(10,)).astype(np.float32))
+    assert bool(jnp.all(k3_kl(a, b) >= -1e-6))
+    np.testing.assert_allclose(np.asarray(k3_kl(a, a)), 0.0, atol=1e-6)
+
+
+def test_ppo_clip_loss_sequence_matches_invalid_fill_semantics(rng):
+    """Masked-sum formulation == reference's sum-over-INVALID-filled tensors."""
+    new, old, ref, _, pad = make_batch(rng)
+    adv_seq = rng.normal(size=(new.shape[0],)).astype(np.float32)
+    cliprange = 0.2
+    loss, _ = ppo_clip_loss_sequence(
+        jnp.asarray(new), jnp.asarray(old), jnp.asarray(adv_seq), jnp.asarray(~pad), cliprange
+    )
+    # oracle: reference sums the filled tensors directly (pads cancel in diff)
+    tn, to, ta = map(torch.from_numpy, (new, old, adv_seq))
+    diff = tn.sum(1) - to.sum(1)
+    ratio = torch.exp(diff)
+    pg1 = -ta * ratio
+    pg2 = -ta * torch.clamp(ratio, 1 - cliprange, 1 + cliprange)
+    want = torch.max(pg1, pg2).mean()
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+
+
+def test_value_loss_clipped(rng):
+    B, T = 4, 6
+    vpred = rng.normal(size=(B, T)).astype(np.float32)
+    values = rng.normal(size=(B, T)).astype(np.float32)
+    returns = rng.normal(size=(B, T)).astype(np.float32)
+    seq_len = rng.integers(1, T - 1, size=(B,))
+    pad_p1 = np.arange(T)[None, :] > (seq_len[:, None] + 1)
+    cv = 0.2
+    loss, aux = value_loss_clipped(
+        jnp.asarray(vpred), jnp.asarray(values), jnp.asarray(returns),
+        jnp.asarray(~pad_p1), cv,
+    )
+    tv, tva, trr, tm = map(torch.from_numpy, (vpred, values, returns, ~pad_p1))
+    vclip = torch.clamp(tv, tva - cv, tva + cv)
+    l1 = (tv - trr) ** 2
+    l2 = (vclip - trr) ** 2
+    want = 0.5 * torch_masked_mean(torch.max(l1, l2), tm)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-4)
+
+
+def test_sft_loss_gradient_matches_invalid_fill_version(rng):
+    """Gradient of masked SFT loss == gradient of the reference's version."""
+    new, _, _, _, pad = make_batch(rng)
+
+    def ours(lp):
+        return sft_loss(lp, jnp.asarray(~pad))[0]
+
+    def reference_style(lp):
+        # pads already carry constant INVALID_LOGPROB; sum everything
+        filled = jnp.where(jnp.asarray(pad), INVALID_LOGPROB, lp)
+        return -jnp.mean(jnp.sum(filled, axis=1))
+
+    g_ours = jax.grad(ours)(jnp.asarray(new))
+    g_ref = jax.grad(reference_style)(jnp.asarray(new))
+    np.testing.assert_allclose(np.asarray(g_ours), np.asarray(g_ref), rtol=1e-5)

@@ -1,0 +1,140 @@
+"""Pin ops/masking semantics against independent torch reference implementations.
+
+The torch references below re-state the TRL-helper formulas the reference
+trainers depend on (SURVEY.md §2.4 'shared numerics') — written fresh here, and
+used only as a numerical oracle.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from nanorlhf_tpu.ops import (
+    INVALID_LOGPROB,
+    exact_div,
+    first_true_indices,
+    truncate_response,
+    masked_mean,
+    masked_var,
+    masked_whiten,
+    response_padding_masks,
+    logprobs_from_logits,
+    entropy_from_logits,
+)
+
+
+def torch_first_true_indices(bools):
+    row_len = bools.size(-1)
+    zero_or_index = row_len * (~bools).long() + torch.arange(row_len).long() * bools.long()
+    return torch.min(zero_or_index, dim=-1).values
+
+
+def test_first_true_indices(rng):
+    bools = rng.random((7, 13)) < 0.2
+    got = first_true_indices(jnp.asarray(bools))
+    want = torch_first_true_indices(torch.from_numpy(bools))
+    np.testing.assert_array_equal(np.asarray(got), want.numpy())
+
+
+def test_first_true_indices_no_true():
+    bools = jnp.zeros((3, 5), dtype=bool)
+    np.testing.assert_array_equal(np.asarray(first_true_indices(bools)), [5, 5, 5])
+
+
+def test_truncate_response():
+    stop, pad = 9, 0
+    resp = jnp.array(
+        [
+            [4, 5, 9, 7, 8],   # stop mid-sequence: keep stop, pad rest
+            [9, 1, 2, 3, 4],   # stop first
+            [1, 2, 3, 4, 5],   # no stop: unchanged
+        ]
+    )
+    got = np.asarray(truncate_response(stop, pad, resp))
+    np.testing.assert_array_equal(
+        got, [[4, 5, 9, 0, 0], [9, 0, 0, 0, 0], [1, 2, 3, 4, 5]]
+    )
+
+
+def test_masked_mean_var_whiten(rng):
+    vals = rng.normal(size=(6, 10)).astype(np.float32)
+    mask = rng.random((6, 10)) < 0.7
+    mask[0] = True  # ensure nonempty
+    jv, jm = jnp.asarray(vals), jnp.asarray(mask)
+    tv, tm = torch.from_numpy(vals), torch.from_numpy(mask)
+
+    t_mean = (tv * tm).sum() / tm.sum()
+    np.testing.assert_allclose(float(masked_mean(jv, jm)), float(t_mean), rtol=1e-5)
+
+    t_var = ((tv - t_mean) ** 2 * tm).sum() / tm.sum()
+    t_var = t_var * tm.sum() / (tm.sum() - 1)
+    np.testing.assert_allclose(float(masked_var(jv, jm)), float(t_var), rtol=1e-5)
+
+    t_whiten = (tv - t_mean) * torch.rsqrt(t_var + 1e-8)
+    np.testing.assert_allclose(
+        np.asarray(masked_whiten(jv, jm)), t_whiten.numpy(), rtol=1e-4, atol=1e-5
+    )
+    t_whiten_keep = t_whiten + t_mean
+    np.testing.assert_allclose(
+        np.asarray(masked_whiten(jv, jm, shift_mean=False)),
+        t_whiten_keep.numpy(),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_masked_mean_axis(rng):
+    vals = rng.normal(size=(4, 8)).astype(np.float32)
+    mask = np.ones((4, 8), dtype=bool)
+    mask[:, 5:] = False
+    got = masked_mean(jnp.asarray(vals), jnp.asarray(mask), axis=1)
+    np.testing.assert_allclose(np.asarray(got), vals[:, :5].mean(axis=1), rtol=1e-5)
+
+
+def test_response_padding_masks():
+    responses = jnp.zeros((2, 6), dtype=jnp.int32)
+    seq_len = jnp.array([2, 5])  # index of last real token
+    pm, pm1 = response_padding_masks(responses, seq_len)
+    np.testing.assert_array_equal(
+        np.asarray(pm),
+        [[False, False, False, True, True, True],
+         [False, False, False, False, False, False]],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pm1),
+        [[False, False, False, False, True, True],
+         [False, False, False, False, False, False]],
+    )
+
+
+def test_logprobs_from_logits_matches_torch(rng):
+    logits = rng.normal(size=(3, 7, 11)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(3, 7))
+    temp = 0.7
+    got = logprobs_from_logits(jnp.asarray(logits), jnp.asarray(labels), temp)
+    t = torch.from_numpy(logits) / temp
+    want = torch.gather(
+        F.log_softmax(t, dim=-1), 2, torch.from_numpy(labels)[..., None]
+    )[..., 0]
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_entropy_from_logits(rng):
+    logits = rng.normal(size=(3, 5, 11)).astype(np.float32)
+    got = entropy_from_logits(jnp.asarray(logits))
+    t = torch.from_numpy(logits)
+    probs = F.softmax(t, dim=-1)
+    want = torch.logsumexp(t, dim=-1) - (probs * t).sum(-1)
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_exact_div():
+    assert exact_div(12, 4) == 3
+    with pytest.raises(ValueError):
+        exact_div(13, 4)
+
+
+def test_invalid_logprob_sentinel():
+    assert INVALID_LOGPROB == 1.0
