@@ -6,6 +6,8 @@ from nanorlhf_tpu.core.model import (
     prefill,
     decode_step,
     init_kv_cache,
+    init_score_head,
+    score_forward,
 )
 
 __all__ = [
@@ -16,4 +18,6 @@ __all__ = [
     "prefill",
     "decode_step",
     "init_kv_cache",
+    "init_score_head",
+    "score_forward",
 ]

@@ -1,0 +1,114 @@
+"""LoRA adapters applied in-graph — no merge/disk round-trip, ever.
+
+The reference wraps the policy with PEFT (r=64, alpha=16, all seven
+projections; embed/lm_head fully trained via `modules_to_save`)
+(`/root/reference/GRPO/grpo.py:86-99,226-243`) and must merge the adapter
+into a full checkpoint on disk every update so vLLM can load it
+(`/root/reference/GRPO/grpo_trainer.py:131-141`). Here the adapter is just an
+extra `params["lora"]` subtree that the decoder applies inline during
+training, scoring *and* sampling — weight freshness is automatic because
+there is only one tree.
+
+Layout mirrors the stacked layer tree: `lora["layers"][proj] = {"a": [L, in, r],
+"b": [L, r, out]}`; contribution `(x @ A) @ B * (alpha / r)`, B zero-init so
+step 0 is exactly the base model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core.config import ModelConfig
+
+ALL_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj", "down_proj")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    r: int = 64
+    alpha: int = 16
+    # default matches `lora_target_modules` (`GRPO/grpo.py:94`)
+    targets: tuple[str, ...] = ALL_TARGETS
+    # fully-trained extras, as `modules_to_save` (`GRPO/grpo.py:95`)
+    train_embed: bool = True
+    train_lm_head: bool = True
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.r
+
+
+def _proj_dims(config: ModelConfig, name: str) -> tuple[int, int]:
+    hd = config.actual_head_dim
+    D, F = config.hidden_size, config.intermediate_size
+    H, KV = config.num_attention_heads, config.num_key_value_heads
+    return {
+        "q_proj": (D, H * hd),
+        "k_proj": (D, KV * hd),
+        "v_proj": (D, KV * hd),
+        "o_proj": (H * hd, D),
+        "gate_proj": (D, F),
+        "up_proj": (D, F),
+        "down_proj": (F, D),
+    }[name]
+
+
+def init_lora_params(
+    config: ModelConfig, lora: LoraConfig, key: jax.Array, dtype=jnp.bfloat16
+) -> dict:
+    """A ~ N(0, 1/r) (kaiming-ish), B = 0 → adapter starts as identity."""
+    L = config.num_hidden_layers
+    keys = jax.random.split(key, len(lora.targets))
+    layers = {}
+    for k, name in zip(keys, lora.targets):
+        d_in, d_out = _proj_dims(config, name)
+        layers[name] = {
+            "a": (jax.random.normal(k, (L, d_in, lora.r), jnp.float32) / jnp.sqrt(lora.r)).astype(dtype),
+            "b": jnp.zeros((L, lora.r, d_out), dtype),
+        }
+    return {"layers": layers}
+
+
+def merge_lora(params: dict, lora_scale: float) -> dict:
+    """Fold the adapter into the base kernels (checkpoint export only —
+    runtime never needs this)."""
+    if "lora" not in params:
+        return params
+    merged = dict(params)
+    lora_layers = params["lora"]["layers"]
+    new_layers = dict(params["layers"])
+    for name, ab in lora_layers.items():
+        delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32), ab["b"].astype(jnp.float32))
+        entry = dict(new_layers[name])
+        entry["kernel"] = (
+            entry["kernel"].astype(jnp.float32) + lora_scale * delta
+        ).astype(entry["kernel"].dtype)
+        new_layers[name] = entry
+    merged["layers"] = new_layers
+    del merged["lora"]
+    return merged
+
+
+def trainable_mask(params: dict, lora: LoraConfig | None) -> dict:
+    """Boolean pytree: which leaves the optimizer updates.
+
+    Full fine-tuning (lora=None): everything True. LoRA: adapter leaves plus
+    (optionally) embed_tokens / lm_head — PEFT `modules_to_save` parity.
+    """
+    if lora is None:
+        return jax.tree.map(lambda _: True, params)
+
+    def mask(path, leaf):
+        keys = tuple(p.key if hasattr(p, "key") else str(p) for p in path)
+        if keys and keys[0] == "lora":
+            return True
+        if keys and keys[0] == "embed_tokens":
+            return lora.train_embed
+        if keys and keys[0] == "lm_head":
+            return lora.train_lm_head
+        return False
+
+    return jax.tree_util.tree_map_with_path(mask, params)

@@ -128,7 +128,20 @@ def gqa_attention(
 # Layer body (scanned)
 # ---------------------------------------------------------------------------
 
-def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache, cache_index):
+def _proj(h, layer_params, lora_layer, name, lora_scale):
+    """x @ W (+ bias) (+ LoRA (x@A)@B · scale) — LoRA applied in-graph so
+    sampling/scoring/training all see fresh adapter weights (core/lora.py)."""
+    y = h @ layer_params[name]["kernel"]
+    if "bias" in layer_params[name]:
+        y = y + layer_params[name]["bias"]
+    if lora_layer is not None and name in lora_layer:
+        ab = lora_layer[name]
+        y = y + ((h @ ab["a"]) @ ab["b"]) * lora_scale
+    return y
+
+
+def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
+                cache_index, lora_layer=None, lora_scale=1.0):
     """One decoder layer. If kv_cache is not None, operate incrementally.
 
     Returns (x_out, new_kv_pair_or_None).
@@ -139,9 +152,9 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache, 
     B, T, D = x.shape
 
     h = rms_norm(x, layer_params["input_layernorm"], config.rms_norm_eps)
-    q = h @ layer_params["q_proj"]["kernel"] + layer_params["q_proj"]["bias"]
-    k = h @ layer_params["k_proj"]["kernel"] + layer_params["k_proj"]["bias"]
-    v = h @ layer_params["v_proj"]["kernel"] + layer_params["v_proj"]["bias"]
+    q = _proj(h, layer_params, lora_layer, "q_proj", lora_scale)
+    k = _proj(h, layer_params, lora_layer, "k_proj", lora_scale)
+    v = _proj(h, layer_params, lora_layer, "v_proj", lora_scale)
     q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
@@ -161,37 +174,54 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache, 
 
     out = gqa_attention(q, attn_k, attn_v, mask)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
-    out = out @ layer_params["o_proj"]["kernel"]
+    out = _proj(out, layer_params, lora_layer, "o_proj", lora_scale)
     x = x + out
 
     h = rms_norm(x, layer_params["post_attention_layernorm"], config.rms_norm_eps)
-    gate = h @ layer_params["gate_proj"]["kernel"]
-    up = h @ layer_params["up_proj"]["kernel"]
-    ff = (jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up) @ layer_params[
-        "down_proj"
-    ]["kernel"]
+    gate = _proj(h, layer_params, lora_layer, "gate_proj", lora_scale)
+    up = _proj(h, layer_params, lora_layer, "up_proj", lora_scale)
+    ff = _proj(
+        jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up,
+        layer_params, lora_layer, "down_proj", lora_scale,
+    )
     x = x + ff
     return x, new_cache
 
 
-def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0):
-    """Scan the stacked layer params over the layer body."""
+def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
+                lora_scale=1.0, remat=False):
+    """Scan the stacked layer params over the layer body.
+
+    `remat=True` wraps the body in jax.checkpoint — the training path's
+    activation rematerialization (capability parity with the reference's
+    `gradient_checkpointing=True`, `/root/reference/GRPO/grpo.py:134`, but
+    trading FLOPs for HBM the XLA way).
+    """
+    lora_layers = params.get("lora", {}).get("layers") if isinstance(params, dict) else None
+
     if kv_caches is None:
-        def body(carry, layer_params):
-            y, _ = _layer_body(config, carry, layer_params, cos, sin, mask, None, 0)
+        def body(carry, inp):
+            layer_params, lora_layer = inp
+            y, _ = _layer_body(config, carry, layer_params, cos, sin, mask, None, 0,
+                               lora_layer, lora_scale)
             return y, None
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        if remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
         return x, None
     else:
         def body(carry, inp):
-            layer_params, k_cache, v_cache = inp
+            layer_params, lora_layer, k_cache, v_cache = inp
             y, new_cache = _layer_body(
-                config, carry, layer_params, cos, sin, mask, (k_cache, v_cache), cache_index
+                config, carry, layer_params, cos, sin, mask, (k_cache, v_cache),
+                cache_index, lora_layer, lora_scale,
             )
             return y, new_cache
 
-        x, new_caches = jax.lax.scan(body, x, (params["layers"], kv_caches[0], kv_caches[1]))
+        x, new_caches = jax.lax.scan(
+            body, x, (params["layers"], lora_layers, kv_caches[0], kv_caches[1])
+        )
         return x, new_caches
 
 
@@ -212,6 +242,8 @@ def model_forward(
     input_ids: jnp.ndarray,       # [B, T]
     attention_mask: jnp.ndarray,  # [B, T] bool/int, True = real token
     position_ids: jnp.ndarray,    # [B, T]
+    lora_scale: float = 1.0,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Full-sequence forward (training / logprob pass). Returns logits [B, T, V]."""
     x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
@@ -219,22 +251,79 @@ def model_forward(
     cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
     causal = jnp.tril(jnp.ones((T, T), bool))
     mask = causal[None, None, :, :] & (attention_mask.astype(bool))[:, None, None, :]
-    x, _ = _run_layers(config, params, x, cos, sin, mask)
+    x, _ = _run_layers(config, params, x, cos, sin, mask,
+                       lora_scale=lora_scale, remat=remat)
     return _logits(config, params, x)
 
 
-def padded_forward_logits(
-    params: dict, config: ModelConfig, query_responses: jnp.ndarray, pad_token_id: int
+def _padded_hidden(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,
+    pad_token_id: int,
+    lora_scale: float = 1.0,
+    remat: bool = False,
 ) -> jnp.ndarray:
-    """Padding-robust forward: the reference's shared `forward()` contract.
+    """Shared padding recipe → pre-final-norm hidden states [B, T, D].
 
     attention_mask = (ids != pad); position_ids = cumsum(mask) - mask; padded
-    ids replaced with 0 (`/root/reference/GRPO/grpo_trainer.py:90-120`).
+    ids replaced with 0 (`/root/reference/GRPO/grpo_trainer.py:90-120`). The
+    single source of truth for both the policy logit pass and the value/RM
+    score pass — their padding numerics must never drift apart.
     """
     attention_mask = query_responses != pad_token_id
     position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
     input_ids = jnp.where(attention_mask, query_responses, 0)
-    return model_forward(params, config, input_ids, attention_mask, position_ids)
+    x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
+    T = input_ids.shape[1]
+    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    mask = causal[None, None, :, :] & attention_mask[:, None, None, :]
+    x, _ = _run_layers(config, params, x, cos, sin, mask,
+                       lora_scale=lora_scale, remat=remat)
+    return x
+
+
+def padded_forward_logits(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,
+    pad_token_id: int,
+    lora_scale: float = 1.0,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Padding-robust forward: the reference's shared `forward()` contract."""
+    x = _padded_hidden(params, config, query_responses, pad_token_id, lora_scale, remat)
+    return _logits(config, params, x)
+
+
+def init_score_head(config: ModelConfig, key: jax.Array, num_labels: int = 1,
+                    dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Score head [D, num_labels] — a value/reward model is the decoder with
+    this head instead of lm_head (HF `AutoModelForSequenceClassification(
+    num_labels=1)`, `/root/reference/PPO/ppo.py:280-287`)."""
+    scale = 1.0 / jnp.sqrt(jnp.float32(config.hidden_size))
+    return (jax.random.normal(key, (config.hidden_size, num_labels), jnp.float32) * scale).astype(dtype)
+
+
+def score_forward(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,
+    pad_token_id: int,
+    lora_scale: float = 1.0,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Per-position scores [B, T, num_labels] from a tree carrying "score".
+
+    Same padding recipe as padded_forward_logits (shared `_padded_hidden`);
+    hidden states are final-normed before the head (matching
+    Qwen2ForSequenceClassification). Used for the PPO value pass
+    (`PPO/ppo_trainer.py:630-634,732`) and RM-based rewards.
+    """
+    x = _padded_hidden(params, config, query_responses, pad_token_id, lora_scale, remat)
+    x = rms_norm(x, params["norm"], config.rms_norm_eps)
+    return (x.astype(jnp.float32) @ params["score"].astype(jnp.float32))
 
 
 def init_kv_cache(
@@ -257,6 +346,7 @@ def prefill(
     input_ids: jnp.ndarray,       # [B, T_prompt]
     attention_mask: jnp.ndarray,  # [B, T_prompt]
     kv_caches: tuple[jnp.ndarray, jnp.ndarray],  # from init_kv_cache, T_max >= T_prompt
+    lora_scale: float = 1.0,
 ):
     """Prompt ingestion: fills the KV cache, returns (last-position logits, caches).
 
@@ -276,7 +366,8 @@ def prefill(
     mask = (causal[None, None, :, :] & attention_mask[:, None, None, :])
     mask_full = jnp.zeros((B, 1, T, T_max), bool).at[:, :, :, :T].set(mask)
     x, new_caches = _run_layers(
-        config, params, x, cos, sin, mask_full, kv_caches=kv_caches, cache_index=0
+        config, params, x, cos, sin, mask_full, kv_caches=kv_caches, cache_index=0,
+        lora_scale=lora_scale,
     )
     logits = _logits(config, params, x[:, -1:, :])[:, 0, :]
     return logits, new_caches
@@ -290,6 +381,7 @@ def decode_step(
     cache_index,                  # scalar: slot to write KV into
     key_mask: jnp.ndarray,        # [B, T_max] bool: which cache slots are valid (incl. this one)
     kv_caches: tuple[jnp.ndarray, jnp.ndarray],
+    lora_scale: float = 1.0,
 ):
     """One autoregressive decode step. Returns (logits [B, V], new caches)."""
     B = token.shape[0]
@@ -297,7 +389,8 @@ def decode_step(
     cos, sin = rope_tables(position[:, None], config.actual_head_dim, config.rope_theta)
     mask = key_mask[:, None, None, :]  # [B, 1, 1, T_max]
     x, new_caches = _run_layers(
-        config, params, x, cos, sin, mask, kv_caches=kv_caches, cache_index=cache_index
+        config, params, x, cos, sin, mask, kv_caches=kv_caches, cache_index=cache_index,
+        lora_scale=lora_scale,
     )
     logits = _logits(config, params, x)[:, 0, :]
     return logits, new_caches

@@ -1,0 +1,10 @@
+from nanorlhf_tpu.data.tokenizer import ToyTokenizer, load_tokenizer
+from nanorlhf_tpu.data.datasets import PromptDataset, load_prompt_dataset, synthetic_prompts
+
+__all__ = [
+    "ToyTokenizer",
+    "load_tokenizer",
+    "PromptDataset",
+    "load_prompt_dataset",
+    "synthetic_prompts",
+]

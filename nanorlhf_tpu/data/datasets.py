@@ -1,0 +1,112 @@
+"""Prompt dataset pipeline.
+
+The reference extracts the first "Human:" turn from Anthropic/hh-rlhf, wraps
+it in the Qwen chat template, pre-tokenizes with dataset.map, and feeds a
+shuffling, drop-last dataloader of *left-padded* prompt id tensors
+(`/root/reference/GRPO/grpo.py:247-270`, `GRPO/grpo_trainer.py:302-310`).
+
+This module reproduces that shape: `PromptDataset` holds pre-tokenized,
+left-padded prompts; `load_prompt_dataset` sources them from HF datasets when
+available locally (zero-egress builds fall back to synthetic prompts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PromptDataset:
+    input_ids: np.ndarray   # [N, T] left-padded
+    pad_token_id: int
+
+    def __len__(self) -> int:
+        return self.input_ids.shape[0]
+
+    def loader(self, batch_size: int, seed: int):
+        """Infinite shuffling iterator, drop-last — dataloader parity with
+        `DataLoader(shuffle=True, drop_last=True)` (`grpo_trainer.py:302-310`)."""
+        rng = np.random.default_rng(seed)
+        n = len(self)
+        assert n >= batch_size, f"dataset ({n}) smaller than batch ({batch_size})"
+        while True:
+            perm = rng.permutation(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                yield self.input_ids[perm[i : i + batch_size]]
+
+
+def _left_pad(seqs: list[list[int]], pad_id: int, max_len: int | None = None) -> np.ndarray:
+    max_len = max_len or max(len(s) for s in seqs)
+    out = np.full((len(seqs), max_len), pad_id, np.int32)
+    for i, s in enumerate(seqs):
+        s = s[-max_len:]
+        out[i, max_len - len(s):] = s
+    return out
+
+
+def extract_hh_question(chosen: str) -> str:
+    """First human turn of an hh-rlhf transcript — mirrors the launcher's
+    string surgery (`GRPO/grpo.py:249-258`)."""
+    text = chosen.split("Human:", 1)[-1]
+    return text.split("Assistant:", 1)[0].strip()
+
+
+def synthetic_prompts(n: int, tokenizer, seed: int = 0, min_words: int = 4,
+                      max_words: int = 24) -> list[str]:
+    """Deterministic offline prompt corpus for smoke runs and tests."""
+    rng = np.random.default_rng(seed)
+    topics = [
+        "how do I learn to cook pasta properly",
+        "explain why the sky appears blue at noon",
+        "what is a good plan for saving money",
+        "describe the history of the printing press",
+        "how can I improve my running endurance",
+        "what makes a good friendship last long",
+        "explain how photosynthesis works in plants",
+        "what should I consider when adopting a dog",
+    ]
+    prompts = []
+    for i in range(n):
+        base = topics[int(rng.integers(len(topics)))]
+        words = base.split()
+        k = int(rng.integers(min_words, min(max_words, len(words)) + 1))
+        prompts.append(" ".join(words[:k]))
+    return prompts
+
+
+def load_prompt_dataset(
+    name: str,
+    tokenizer,
+    split: str = "train",
+    max_prompt_len: int = 256,
+    limit: int | None = None,
+    seed: int = 0,
+) -> PromptDataset:
+    """hh-rlhf-style prompt dataset; `synthetic:<n>` for the offline corpus.
+
+    Applies the chat template (`GRPO/grpo.py:259-263`) then tokenizes and
+    left-pads to the batch max — matching the reference's pre-tokenized
+    dataloader contract.
+    """
+    if name.startswith("synthetic"):
+        _, _, count = name.partition(":")
+        texts = synthetic_prompts(int(count) if count else 512, tokenizer, seed)
+    else:
+        import datasets  # requires local cache in zero-egress builds
+
+        ds = datasets.load_dataset(name, split=split)
+        texts = [extract_hh_question(row["chosen"]) for row in ds]
+
+    if limit:
+        texts = texts[:limit]
+
+    templated = [
+        tokenizer.apply_chat_template(
+            [{"role": "user", "content": t}], tokenize=False, add_generation_prompt=True
+        )
+        for t in texts
+    ]
+    ids = [tokenizer.encode(t)[:max_prompt_len] for t in templated]
+    return PromptDataset(_left_pad(ids, tokenizer.pad_token_id), tokenizer.pad_token_id)

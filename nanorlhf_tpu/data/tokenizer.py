@@ -1,0 +1,88 @@
+"""Tokenizer protocol: HF fast tokenizers when available, a toy fallback.
+
+Tokenization stays host-side/CPU, outside the compiled graph — same split as
+the reference, which runs Rust HF tokenizers on the host
+(`/root/reference/GRPO/grpo.py:209-216`, SURVEY.md §2.2). The toy tokenizer
+exists because this build environment has zero egress: smoke tests and CPU
+integration runs need a self-contained vocabulary.
+
+Both implementations expose the slice of the HF interface the trainers use:
+`pad_token_id`, `eos_token_id`, `eos_token`, `encode`, `batch_decode`,
+`__call__(text, padding='max_length' | longest-style)`.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+
+
+class ToyTokenizer:
+    """Whitespace/word-piece-free toy tokenizer with a stable hashed vocab.
+
+    Deterministic, reversible for its own output (each id maps to one word),
+    with the special tokens the trainers rely on: `[PAD]`=0 (the reference
+    adds a `[PAD]` token, `GRPO/grpo.py:210-216`) and an EOS.
+    """
+
+    def __init__(self, vocab_size: int = 4096):
+        self.vocab_size = vocab_size
+        self.pad_token = "[PAD]"
+        self.eos_token = "</s>"
+        self.pad_token_id = 0
+        self.eos_token_id = 1
+        self.unk_token_id = 2
+        self._id_to_word: dict[int, str] = {}
+
+    def _word_id(self, word: str) -> int:
+        if word == self.pad_token:
+            return self.pad_token_id
+        if word == self.eos_token:
+            return self.eos_token_id
+        # crc32, not hash(): Python's str hash is salted per process, which
+        # would silently desync vocab across restarts/hosts
+        h = 3 + (zlib.crc32(word.encode()) % (self.vocab_size - 3))
+        self._id_to_word.setdefault(h, word)
+        return h
+
+    def encode(self, text: str) -> list[int]:
+        words = re.findall(r"\S+", text)
+        return [self._word_id(w) for w in words]
+
+    def decode(self, ids, skip_special_tokens: bool = False) -> str:
+        out = []
+        for i in ids:
+            i = int(i)
+            if i == self.pad_token_id:
+                if not skip_special_tokens:
+                    out.append(self.pad_token)
+            elif i == self.eos_token_id:
+                if not skip_special_tokens:
+                    out.append(self.eos_token)
+            else:
+                out.append(self._id_to_word.get(i, f"<unk:{i}>"))
+        return " ".join(out)
+
+    def batch_decode(self, batch, skip_special_tokens: bool = False) -> list[str]:
+        return [self.decode(row, skip_special_tokens) for row in batch]
+
+    def apply_chat_template(self, messages, tokenize=False, add_generation_prompt=True):
+        text = " ".join(m["content"] for m in messages)
+        return f"<user> {text} <assistant>"
+
+
+def load_tokenizer(name_or_path: str):
+    """HF AutoTokenizer with the reference's [PAD] handling; toy fallback.
+
+    `toy:<vocab_size>` explicitly requests the toy tokenizer.
+    """
+    if name_or_path.startswith("toy"):
+        _, _, size = name_or_path.partition(":")
+        return ToyTokenizer(int(size) if size else 4096)
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name_or_path, padding_side="left")
+    if tok.pad_token is None:
+        # same move as the reference (`GRPO/grpo.py:210-216`)
+        tok.add_special_tokens({"pad_token": "[PAD]"})
+    return tok

@@ -1,0 +1,9 @@
+from nanorlhf_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules, shard_params, batch_sharding
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "param_sharding_rules",
+    "shard_params",
+    "batch_sharding",
+]

@@ -1,0 +1,119 @@
+"""Device mesh + sharding rules — the TPU replacement for the reference's
+entire memory/distribution story.
+
+The reference scales by CPU↔GPU offload choreography and an unused
+accelerate/NCCL scaffold (SURVEY.md §2.3, `/root/reference/GRPO/
+grpo_trainer.py:168-172,475-476,622-626`). Here the same capability is a
+`jax.sharding.Mesh` with axes:
+
+- `data`  — batch/data parallel (primary scaling axis; DCN axis multi-slice)
+- `fsdp`  — parameter/optimizer-state sharding (ZeRO-equivalent; replaces the
+            optimizer-state CPU paging entirely)
+- `tensor`— megatron-style tensor parallel for >8B models
+
+All rules are GSPMD PartitionSpecs over the *stacked* param tree of
+core/model.py; XLA inserts the collectives (psum/all-gather over ICI).
+Batch axes shard over (data, fsdp) jointly — fsdp acts as a second data axis
+for activations, param all-gathers ride the fsdp axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int = -1      # -1 = all remaining devices
+    fsdp: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        d, f, t = self.data, self.fsdp, self.tensor
+        known = (f if f > 0 else 1) * (t if t > 0 else 1)
+        if d == -1:
+            d = n_devices // known
+        if d * f * t != n_devices:
+            raise ValueError(
+                f"mesh {d}x{f}x{t} != {n_devices} devices"
+            )
+        return d, f, t
+
+
+def make_mesh(config: MeshConfig = MeshConfig(), devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    d, f, t = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, f, t)
+    return Mesh(arr, ("data", "fsdp", "tensor"))
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules for the stacked Qwen2 tree (+ optional LoRA subtree)
+# ---------------------------------------------------------------------------
+
+# leaf-name path suffix -> PartitionSpec (leading None = stacked layer axis)
+_RULES = {
+    ("embed_tokens",): P("tensor", "fsdp"),
+    ("norm",): P(None),
+    ("lm_head",): P("fsdp", "tensor"),
+    # attention: out-features sharded by tensor, in-features by fsdp
+    ("layers", "q_proj", "kernel"): P(None, "fsdp", "tensor"),
+    ("layers", "k_proj", "kernel"): P(None, "fsdp", "tensor"),
+    ("layers", "v_proj", "kernel"): P(None, "fsdp", "tensor"),
+    ("layers", "q_proj", "bias"): P(None, "tensor"),
+    ("layers", "k_proj", "bias"): P(None, "tensor"),
+    ("layers", "v_proj", "bias"): P(None, "tensor"),
+    ("layers", "o_proj", "kernel"): P(None, "tensor", "fsdp"),
+    # mlp: intermediate dim by tensor
+    ("layers", "gate_proj", "kernel"): P(None, "fsdp", "tensor"),
+    ("layers", "up_proj", "kernel"): P(None, "fsdp", "tensor"),
+    ("layers", "down_proj", "kernel"): P(None, "tensor", "fsdp"),
+    ("layers", "input_layernorm"): P(None, None),
+    ("layers", "post_attention_layernorm"): P(None, None),
+    # LoRA: A shards like the input dim, B like the output dim
+    ("a",): P(None, "fsdp", None),
+    ("b",): P(None, None, "tensor"),
+}
+
+_RULES_BY_LEN = sorted(_RULES.items(), key=lambda kv: -len(kv[0]))
+
+
+def _spec_for_path(path: tuple[str, ...]) -> P:
+    for suffix, spec in _RULES_BY_LEN:
+        if path[-len(suffix):] == suffix:
+            return spec
+    return P()  # replicate anything unmatched
+
+
+def param_sharding_rules(params) -> dict:
+    """PartitionSpec pytree matching `params` (works for LoRA subtrees too)."""
+
+    def spec(path, leaf):
+        keys = tuple(
+            p.key if hasattr(p, "key") else str(p) for p in path
+        )
+        # strip a leading "lora" namespace so LoRA trees reuse layer rules
+        if keys and keys[0] == "lora":
+            keys = keys[1:]
+        return _spec_for_path(keys)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """Place a param tree on the mesh according to the rules (host → device)."""
+    rules = rules if rules is not None else param_sharding_rules(params)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params,
+        rules,
+    )
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the batch dim over (data, fsdp); replicate other dims."""
+    return NamedSharding(mesh, P(("data", "fsdp"), *([None] * (ndim - 1))))

@@ -67,7 +67,7 @@ def _sample_token(key, logits, temperature, top_p, greedy):
 @partial(
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
-                     "temperature", "top_p", "greedy"),
+                     "temperature", "top_p", "greedy", "lora_scale"),
 )
 def generate_tokens(
     params: dict,
@@ -82,6 +82,7 @@ def generate_tokens(
     temperature: float = 1.0,
     top_p: float = 0.95,
     greedy: bool = False,
+    lora_scale: float = 1.0,
 ) -> jnp.ndarray:
     """Core jitted loop: one sample per row. Returns [B, max_tokens] int32."""
     B, Tp = prompt_ids.shape
@@ -90,7 +91,8 @@ def generate_tokens(
     dtype = params["embed_tokens"].dtype
 
     caches = init_kv_cache(config, B, T_max, dtype)
-    first_logits, caches = prefill(params, config, prompt_ids, prompt_mask, caches)
+    first_logits, caches = prefill(params, config, prompt_ids, prompt_mask, caches,
+                                   lora_scale=lora_scale)
 
     prompt_len = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # real prompt length
     key_mask0 = jnp.zeros((B, T_max), bool).at[:, :Tp].set(prompt_mask)
@@ -113,7 +115,8 @@ def generate_tokens(
         key_mask = key_mask.at[:, cache_slot].set(True)  # current slot becomes visible
         position = prompt_len + step - 1
         logits, caches = decode_step(
-            params, config, cur_tok, position, cache_slot, key_mask, caches
+            params, config, cur_tok, position, cache_slot, key_mask, caches,
+            lora_scale=lora_scale,
         )
         key, k = jax.random.split(key)
         tok = _sample_token(k, logits, temperature, top_p, greedy)
@@ -138,6 +141,7 @@ def generate(
     sampling: SamplingParams,
     eos_token_id: int,
     pad_token_id: int,
+    lora_scale: float = 1.0,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per prompt."""
     if sampling.n > 1:
@@ -155,4 +159,5 @@ def generate(
         temperature=sampling.temperature,
         top_p=sampling.top_p,
         greedy=sampling.greedy,
+        lora_scale=lora_scale,
     )

@@ -1,0 +1,4 @@
+from nanorlhf_tpu.trainer.config import RLConfig, AlgoName
+from nanorlhf_tpu.trainer.trainer import RLTrainer
+
+__all__ = ["RLConfig", "AlgoName", "RLTrainer"]

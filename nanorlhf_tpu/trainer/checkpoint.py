@@ -1,0 +1,112 @@
+"""Orbax checkpointing with the reference's rotation + best-metric semantics.
+
+Reference behavior to preserve (SURVEY.md §5.4, `/root/reference/GRPO/
+grpo_trainer.py:321-404`): checkpoint every `save_steps`; rotate to
+`save_total_limit`; `load_best_model_at_end` keyed on a `..._old` metric,
+where the `_old` suffix means the metric describes the *previous* checkpoint —
+so the best checkpoint is resolved one save back (`:374-382`). The best
+checkpoint is never rotated away.
+
+TPU-native mechanics: Orbax writes the sharded param/optimizer trees directly
+from HBM (async-capable); PRNG key and step go in a JSON trainer state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+
+class CheckpointManager:
+    def __init__(self, output_dir: str, save_total_limit: int = 8,
+                 greater_is_better: bool = True):
+        self.output_dir = os.path.abspath(output_dir)
+        self.save_total_limit = save_total_limit
+        self.greater_is_better = greater_is_better
+        os.makedirs(self.output_dir, exist_ok=True)
+        self._ckpt_dirs: list[str] = self._existing()
+        # metric history: step -> metric measured ON that step's saved policy
+        # (arrives one save later under the `_old` convention)
+        self._metric_by_step: dict[int, float] = {}
+        self._last_saved_step: int | None = None
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def _existing(self) -> list[str]:
+        if not os.path.isdir(self.output_dir):
+            return []
+        dirs = [
+            d for d in os.listdir(self.output_dir) if d.startswith("checkpoint-")
+        ]
+        return sorted(
+            (os.path.join(self.output_dir, d) for d in dirs),
+            key=lambda p: int(p.rsplit("-", 1)[1]),
+        )
+
+    def save(self, step: int, params, opt_state=None, rng_key=None,
+             metric_old: float | None = None, extra_state: dict | None = None):
+        """Save a checkpoint. `metric_old`, when given, scores the *previous*
+        checkpoint (the `_old` semantics) and is recorded against it."""
+        if metric_old is not None and self._last_saved_step is not None:
+            self._metric_by_step[self._last_saved_step] = float(metric_old)
+
+        path = os.path.join(self.output_dir, f"checkpoint-{step}")
+        shutil.rmtree(path, ignore_errors=True)
+        tree = {"params": params}
+        if opt_state is not None:
+            tree["opt_state"] = opt_state
+        self._ckptr.save(os.path.join(path, "tree"), tree)
+        state = {"step": step}
+        if rng_key is not None:
+            state["rng_key"] = np.asarray(jax.random.key_data(rng_key)).tolist()
+        state.update(extra_state or {})
+        with open(os.path.join(path, "trainer_state.json"), "w") as f:
+            json.dump(state, f)
+        self._ckpt_dirs.append(path)
+        self._last_saved_step = step
+        self._rotate()
+        return path
+
+    def best_step(self) -> int | None:
+        if not self._metric_by_step:
+            return None
+        pick = max if self.greater_is_better else min
+        return pick(self._metric_by_step, key=self._metric_by_step.get)
+
+    def _rotate(self):
+        keep_always = set()
+        best = self.best_step()
+        if best is not None:
+            keep_always.add(os.path.join(self.output_dir, f"checkpoint-{best}"))
+        while len(self._ckpt_dirs) > self.save_total_limit:
+            for d in self._ckpt_dirs:
+                if d not in keep_always:
+                    shutil.rmtree(d, ignore_errors=True)
+                    self._ckpt_dirs.remove(d)
+                    break
+            else:
+                break  # everything is protected
+
+    def restore(self, step: int, like):
+        """Restore the pytree saved at `step`, matching the structure/shardings
+        of `like` (pass {"params": params_template, ...})."""
+        path = os.path.join(self.output_dir, f"checkpoint-{step}", "tree")
+        import orbax.checkpoint as ocp
+
+        restored = self._ckptr.restore(path, item=like)
+        return restored
+
+    def load_trainer_state(self, step: int) -> dict:
+        with open(
+            os.path.join(self.output_dir, f"checkpoint-{step}", "trainer_state.json")
+        ) as f:
+            return json.load(f)
+
+    def latest_step(self) -> int | None:
+        dirs = self._existing()
+        return int(dirs[-1].rsplit("-", 1)[1]) if dirs else None

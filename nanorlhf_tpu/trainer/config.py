@@ -1,0 +1,139 @@
+"""Layered dataclass config — "ALL setting is on the file you run".
+
+Mirrors the reference's config surface: a PPOConfig-style dataclass holding
+the TRL-inherited fields every launcher sets (`/root/reference/GRPO/
+grpo.py:86-155`, SURVEY.md §5.6) plus algorithm-specific fields, extended
+with the mesh/sharding knobs the TPU runtime needs. The derived batch-size
+hierarchy reproduces `GRPOTrainer.__init__` exactly
+(`/root/reference/GRPO/grpo_trainer.py:216-247`):
+
+    local_batch_size = per_device_train_batch_size
+                       × gradient_accumulation_steps × num_mini_batches
+    batch_size       = local_batch_size × world_size (= mesh data axes)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+from typing import Optional
+
+from nanorlhf_tpu.ops.masking import exact_div
+from nanorlhf_tpu.parallel.mesh import MeshConfig
+
+
+class AlgoName(str, enum.Enum):
+    PPO = "ppo"
+    GRPO = "grpo"
+    RLOO = "rloo"
+    REMAX = "remax"
+    REINFORCE = "reinforce"
+    RAFT = "raft"
+
+
+@dataclasses.dataclass
+class RLConfig:
+    # ---- experiment ----
+    exp_name: str = "run"
+    seed: int = 1
+    output_dir: str = "output"
+    algo: AlgoName = AlgoName.GRPO
+
+    # ---- models ----
+    sft_model_path: str = ""
+    reward_model_path: str = ""
+
+    # ---- rollout / sampling ----
+    response_length: int = 1500          # max new tokens (`GRPO/grpo.py:125`)
+    temperature: float = 0.9
+    top_p: float = 0.95
+    sample_n: int = 4                    # grpo_sample_N / rloo_sample_N / raft_sample_K
+    stop_token: str = "eos"
+    missing_eos_penalty: Optional[float] = None
+
+    # ---- batch hierarchy ----
+    total_episodes: int = 10000
+    per_device_train_batch_size: int = 4
+    gradient_accumulation_steps: int = 8
+    num_mini_batches: int = 16
+    num_ppo_epochs: int = 1
+    local_rollout_forward_batch_size: Optional[int] = None  # None → memory formula
+
+    # ---- optimization ----
+    learning_rate: float = 6e-6
+    value_learning_rate: Optional[float] = None  # PPO separate value LR (`PPO/ppo.py:118-119`)
+    warmup_steps: int = 0
+    min_lr_rate: float = 0.1             # cosine_with_min_lr (`GRPO/grpo.py:119-121`)
+    adam_eps: float = 1e-8
+    weight_decay: float = 0.0
+    max_grad_norm: Optional[float] = None
+
+    # ---- RL coefficients ----
+    kl_coef: float = 0.01
+    cliprange: float = 0.2
+    cliprange_value: float = 0.01
+    vf_coef: float = 0.1
+    gamma: float = 1.0
+    lam: float = 0.95
+    whiten_rewards: bool = False
+    advantage_whiten: bool = False       # REINFORCE defaults True in its launcher
+
+    # ---- LoRA ----
+    use_lora: bool = True
+    lora_r: int = 64
+    lora_alpha: int = 16
+
+    # ---- memory ----
+    gradient_checkpointing: bool = True
+
+    # ---- checkpoint / eval / logging ----
+    save_steps: int = 1
+    save_total_limit: int = 8
+    metric_for_best_model: str = "eval_objective/rlhf_reward_old"
+    greater_is_better: bool = True
+    load_best_model_at_end: bool = True
+    eval_steps: int = 1
+    logging_steps: int = 1
+    num_printed_samples: int = 5         # rich-table rows (`GRPO/grpo_trainer.py:717`)
+    report_to: str = "jsonl"             # "jsonl" | "none" (wandb needs egress)
+
+    # ---- mesh ----
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    # ---- derived (filled by finalize) ----
+    world_size: int = dataclasses.field(default=1, init=False)
+    local_batch_size: int = dataclasses.field(default=0, init=False)
+    micro_batch_size: int = dataclasses.field(default=0, init=False)
+    batch_size: int = dataclasses.field(default=0, init=False)
+    mini_batch_size: int = dataclasses.field(default=0, init=False)
+    local_mini_batch_size: int = dataclasses.field(default=0, init=False)
+    num_total_batches: int = dataclasses.field(default=0, init=False)
+
+    def finalize(self, n_devices: int) -> "RLConfig":
+        """Derive the batch hierarchy. `world_size` = data-parallel extent of
+        the mesh (data × fsdp axes — both shard the batch)."""
+        d, f, t = self.mesh.resolve(n_devices)
+        self.world_size = d * f
+        self.local_batch_size = (
+            self.per_device_train_batch_size
+            * self.gradient_accumulation_steps
+            * self.num_mini_batches
+        )
+        self.micro_batch_size = self.per_device_train_batch_size * self.world_size
+        self.batch_size = self.local_batch_size * self.world_size
+        self.mini_batch_size = exact_div(
+            self.batch_size, self.num_mini_batches,
+            "`batch_size` must be a multiple of `num_mini_batches`",
+        )
+        self.local_mini_batch_size = exact_div(
+            self.local_batch_size, self.num_mini_batches,
+            "`local_batch_size` must be a multiple of `num_mini_batches`",
+        )
+        if self.whiten_rewards and self.local_mini_batch_size < 8:
+            raise ValueError(
+                f"Per-rank minibatch size {self.local_mini_batch_size} is "
+                "insufficient for whitening"
+            )
+        self.num_total_batches = math.ceil(self.total_episodes / self.batch_size)
+        return self

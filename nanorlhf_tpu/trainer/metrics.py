@@ -1,0 +1,57 @@
+"""Metrics with the reference's `_old`/`_new` naming + sample tables.
+
+Naming convention (SURVEY.md §5.5): `_old` = measured on the rollout
+(pre-update) policy, `_new` = measured during the update pass — e.g.
+`eval_objective/rlhf_reward_old`, `policy/approxkl_avg_new`
+(`/root/reference/GRPO/grpo_trainer.py:726-747`). Completion samples print as
+a small table each update (`:711-724`). wandb needs egress; the default sink
+is a JSONL file any dashboard can tail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class MetricsLogger:
+    def __init__(self, output_dir: str, report_to: str = "jsonl"):
+        self.output_dir = output_dir
+        self.report_to = report_to
+        self._fh = None
+        if report_to == "jsonl":
+            os.makedirs(output_dir, exist_ok=True)
+            self._fh = open(os.path.join(output_dir, "metrics.jsonl"), "a")
+
+    def log(self, step: int, episode: int, metrics: dict):
+        record = {"step": step, "episode": episode, "time": time.time()}
+        record.update({k: float(v) for k, v in metrics.items()})
+        line = json.dumps(record)
+        print(f"[step {step}] " + " ".join(
+            f"{k}={record[k]:.4g}" for k in sorted(metrics)[:8]
+        ))
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def log_samples(self, step: int, queries: list[str], responses: list[str],
+                    scores, limit: int = 5):
+        """Console sample table — the rich-table parity
+        (`GRPO/grpo_trainer.py:711-724`)."""
+        print(f"--- samples @ step {step} ---")
+        for q, r, s in list(zip(queries, responses, scores))[:limit]:
+            q1 = q.replace("\n", " ")[:80]
+            r1 = r.replace("\n", " ")[:120]
+            print(f"  score={float(s):+.3f} | {q1!r} -> {r1!r}")
+        if self._fh:
+            rows = [
+                {"query": q, "response": r, "score": float(s)}
+                for q, r, s in list(zip(queries, responses, scores))[:limit]
+            ]
+            self._fh.write(json.dumps({"step": step, "samples": rows}) + "\n")
+            self._fh.flush()
+
+    def close(self):
+        if self._fh:
+            self._fh.close()

@@ -1,0 +1,68 @@
+"""In-graph LoRA: identity at init, effect when trained, merge equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nanorlhf_tpu.core import ModelConfig, init_params, model_forward
+from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params, merge_lora, trainable_mask
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    lora_cfg = LoraConfig(r=4, alpha=8)
+    lora = init_lora_params(config, lora_cfg, jax.random.PRNGKey(1), jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(2, 128, (2, 6)))
+    mask = jnp.ones_like(ids)
+    pos = jnp.cumsum(mask, axis=1) - 1
+    return config, params, lora_cfg, lora, ids, mask, pos
+
+
+def test_lora_zero_init_is_identity(setup):
+    config, params, lora_cfg, lora, ids, mask, pos = setup
+    base = model_forward(params, config, ids, mask, pos)
+    with_lora = model_forward(
+        {**params, "lora": lora}, config, ids, mask, pos, lora_scale=lora_cfg.scale
+    )
+    np.testing.assert_allclose(np.asarray(base), np.asarray(with_lora), atol=1e-6)
+
+
+def _perturbed(lora):
+    return jax.tree.map(
+        lambda x: x + 0.01 * jax.random.normal(jax.random.PRNGKey(3), x.shape, x.dtype),
+        lora,
+    )
+
+
+def test_lora_changes_output_and_merge_matches(setup):
+    config, params, lora_cfg, lora, ids, mask, pos = setup
+    lora_p = _perturbed(lora)
+    base = model_forward(params, config, ids, mask, pos)
+    in_graph = model_forward(
+        {**params, "lora": lora_p}, config, ids, mask, pos, lora_scale=lora_cfg.scale
+    )
+    assert not np.allclose(np.asarray(base), np.asarray(in_graph), atol=1e-5)
+    merged = merge_lora({**params, "lora": lora_p}, lora_cfg.scale)
+    assert "lora" not in merged
+    merged_out = model_forward(merged, config, ids, mask, pos)
+    np.testing.assert_allclose(
+        np.asarray(in_graph), np.asarray(merged_out), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_trainable_mask(setup):
+    config, params, lora_cfg, lora, *_ = setup
+    full = {**params, "lora": lora}
+    mask = trainable_mask(full, lora_cfg)
+    assert mask["embed_tokens"] is True
+    assert mask["norm"] is False
+    assert mask["layers"]["q_proj"]["kernel"] is False
+    assert all(jax.tree.leaves(mask["lora"]))
+    # full fine-tune: everything trainable
+    assert all(jax.tree.leaves(trainable_mask(full, None)))
+    # frozen embeddings variant
+    m2 = trainable_mask(full, LoraConfig(train_embed=False, train_lm_head=False))
+    assert m2["embed_tokens"] is False
