@@ -1,0 +1,118 @@
+"""Benchmark: GRPO episodes/sec/chip on the flagship-shaped policy.
+
+Measures one full GRPO update — rollout (N samples/prompt, jitted KV-cache
+decode), reward, group advantage + keep-1-of-N, chunked policy+ref logprob
+pass, and the jitted minibatch update — end to end, and reports
+episodes/sec/chip against the reference baseline of ~1 s/episode on one
+A100 40G (`BASELINE.md`; reference runtime print
+`/root/reference/GRPO/grpo_trainer.py:726`).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "episodes/s/chip", "vs_baseline": N}
+
+Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
+BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params
+    from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+    from nanorlhf_tpu.parallel import MeshConfig
+    from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
+
+    n_prompts = int(os.environ.get("BENCH_PROMPTS", 32))
+    sample_n = int(os.environ.get("BENCH_SAMPLE_N", 4))
+    response_len = int(os.environ.get("BENCH_RESPONSE", 256))
+    model_name = os.environ.get("BENCH_MODEL", "1_5b")
+    n_updates = int(os.environ.get("BENCH_UPDATES", 2))
+
+    n_dev = len(jax.devices())
+    mcfg = (
+        ModelConfig.qwen2_1_5b() if model_name == "1_5b"
+        else ModelConfig.qwen2_tiny(vocab_size=4096)
+    )
+    dtype = jnp.bfloat16
+    tok = ToyTokenizer(vocab_size=min(4096, mcfg.vocab_size))
+    params = init_params(mcfg, jax.random.PRNGKey(0), dtype)
+
+    # batch hierarchy: one update consumes n_prompts episodes
+    grad_accum = 2 if n_prompts % (2 * 2 * n_dev) == 0 else 1
+    num_mini = 2 if n_prompts % (2 * grad_accum * n_dev) == 0 else 1
+    per_dev = n_prompts // (grad_accum * num_mini * n_dev)
+    assert per_dev >= 1, "BENCH_PROMPTS too small for device count"
+
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir="/tmp/nanorlhf_tpu_bench",
+        response_length=response_len,
+        temperature=0.9,
+        sample_n=sample_n,
+        per_device_train_batch_size=per_dev,
+        gradient_accumulation_steps=grad_accum,
+        num_mini_batches=num_mini,
+        num_ppo_epochs=1,
+        kl_coef=0.01,
+        use_lora=True,
+        gradient_checkpointing=True,
+        mesh=MeshConfig(n_dev, 1, 1),
+        save_steps=0,
+        report_to="none",
+        logging_steps=10**9,
+    )
+    cfg.total_episodes = n_prompts * (n_updates + 1)  # +1 warmup/compile update
+
+    def reward(pmt_and_responses, eos_token):
+        # cheap rule-based reward: keeps the bench focused on the TPU path
+        return np.asarray(
+            [(1.0 if eos_token in s else 0.0) - 0.001 * len(s.split())
+             for s in pmt_and_responses],
+            np.float32,
+        )
+
+    dataset = load_prompt_dataset(f"synthetic:{max(64, n_prompts * 2)}", tok,
+                                  max_prompt_len=64)
+    trainer = RLTrainer(cfg, mcfg, tok, params, dataset, reward)
+
+    # run update-by-update so compile time (first update) is excluded
+    times = []
+    for _ in range(n_updates + 1):
+        t0 = time.time()
+        trainer.train(num_updates=1)
+        times.append(time.time() - t0)
+
+    steady = times[1:] if len(times) > 1 else times
+    sec_per_update = float(np.mean(steady))
+    eps_per_sec_per_chip = n_prompts / sec_per_update / n_dev
+
+    baseline_eps_per_sec = 1.0  # reference: ~1 s/episode on one A100 40G
+    print(json.dumps({
+        "metric": "grpo_episodes_per_sec_per_chip",
+        "value": round(eps_per_sec_per_chip, 4),
+        "unit": "episodes/s/chip",
+        "vs_baseline": round(eps_per_sec_per_chip / baseline_eps_per_sec, 4),
+        "detail": {
+            "model": model_name,
+            "prompts_per_update": n_prompts,
+            "sample_n": sample_n,
+            "response_length": response_len,
+            "devices": n_dev,
+            "sec_per_update_steady": round(sec_per_update, 3),
+            "compile_update_sec": round(times[0], 3),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -246,14 +246,24 @@ def model_forward(
     remat: bool = False,
 ) -> jnp.ndarray:
     """Full-sequence forward (training / logprob pass). Returns logits [B, T, V]."""
+    x = _hidden_from_inputs(params, config, input_ids, attention_mask,
+                            position_ids, lora_scale, remat)
+    return _logits(config, params, x)
+
+
+def _hidden_from_inputs(params, config, input_ids, attention_mask, position_ids,
+                        lora_scale, remat):
+    """embed → rope → causal+padding mask → scanned layers. The one copy of
+    this recipe; every forward entrypoint goes through it."""
+    attention_mask = attention_mask.astype(bool)
     x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
-    B, T = input_ids.shape
+    T = input_ids.shape[1]
     cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
     causal = jnp.tril(jnp.ones((T, T), bool))
-    mask = causal[None, None, :, :] & (attention_mask.astype(bool))[:, None, None, :]
+    mask = causal[None, None, :, :] & attention_mask[:, None, None, :]
     x, _ = _run_layers(config, params, x, cos, sin, mask,
                        lora_scale=lora_scale, remat=remat)
-    return _logits(config, params, x)
+    return x
 
 
 def _padded_hidden(
@@ -274,14 +284,8 @@ def _padded_hidden(
     attention_mask = query_responses != pad_token_id
     position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
     input_ids = jnp.where(attention_mask, query_responses, 0)
-    x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
-    T = input_ids.shape[1]
-    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    mask = causal[None, None, :, :] & attention_mask[:, None, None, :]
-    x, _ = _run_layers(config, params, x, cos, sin, mask,
-                       lora_scale=lora_scale, remat=remat)
-    return x
+    return _hidden_from_inputs(params, config, input_ids, attention_mask,
+                               position_ids, lora_scale, remat)
 
 
 def padded_forward_logits(

@@ -79,10 +79,15 @@ class CheckpointManager:
         return pick(self._metric_by_step, key=self._metric_by_step.get)
 
     def _rotate(self):
+        # never delete the best checkpoint, nor the newest one (its metric
+        # arrives one save later under the `_old` convention, so it may still
+        # become best — and it is the resume point)
         keep_always = set()
         best = self.best_step()
         if best is not None:
             keep_always.add(os.path.join(self.output_dir, f"checkpoint-{best}"))
+        if self._ckpt_dirs:
+            keep_always.add(self._ckpt_dirs[-1])
         while len(self._ckpt_dirs) > self.save_total_limit:
             for d in self._ckpt_dirs:
                 if d not in keep_always:

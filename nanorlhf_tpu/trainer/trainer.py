@@ -345,7 +345,10 @@ class RLTrainer:
         )
 
     def _score_chunk_fn(self):
-        """Jitted policy+ref logprob scorer for one rollout chunk."""
+        """Jitted policy+ref logprob scorer for one rollout chunk (cached —
+        repeated train() calls must reuse the compiled executable)."""
+        if hasattr(self, "_score_fn_cached"):
+            return self._score_fn_cached
         mcfg, cfg = self.mcfg, self.cfg
         pad_id = self.tokenizer.pad_token_id
         lora_scale = self.lora_scale
@@ -365,13 +368,14 @@ class RLTrainer:
             ref_logprobs = logprobs_from_logits(ref_logits, responses, cfg.temperature)
             return logprobs, ref_logprobs
 
+        self._score_fn_cached = score
         return score
 
     # ------------------------------------------------------------------ #
     # the training loop
     # ------------------------------------------------------------------ #
 
-    def train(self):
+    def train(self, num_updates: Optional[int] = None):
         cfg = self.cfg
         tok = self.tokenizer
         pad_id, eos_id = tok.pad_token_id, tok.eos_token_id
@@ -384,7 +388,8 @@ class RLTrainer:
             max_tokens=cfg.response_length,
         )
 
-        for update in range(1, cfg.num_total_batches + 1):
+        n_updates = cfg.num_total_batches if num_updates is None else num_updates
+        for update in range(1, n_updates + 1):
             t_start = time.time()
             self.state["episode"] += cfg.batch_size
             queries = np.asarray(next(self._iter))          # [B, Tp] left-padded
@@ -534,10 +539,13 @@ class RLTrainer:
                     trainable, self.opt_state, stats = self._update_fn(
                         trainable, frozen, self.opt_state, mb, context_length
                     )
-                    all_stats.append(jax.tree.map(float, stats))
+                    # keep stats on device; syncing per minibatch would
+                    # serialize update dispatch
+                    all_stats.append(stats)
             train_tree = self._combine(trainable, frozen)
             self.params = train_tree["policy"]
             self.value_params = train_tree.get("value")
+            all_stats = jax.device_get(all_stats)
 
             # ---- METRICS ---------------------------------------------------
             sec_per_episode = (time.time() - t_start) / cfg.batch_size
@@ -586,8 +594,19 @@ class RLTrainer:
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
                 )
-        self.logger.close()
+
+        # load_best_model_at_end parity (`GRPO/grpo.py:149`, resolved via the
+        # `_old` one-save-back metric semantics, `grpo_trainer.py:374-382`)
+        if cfg.load_best_model_at_end and num_updates is None:
+            best = self.ckpt.best_step()
+            if best is not None and best != self.state["global_step"]:
+                restored = self.ckpt.restore(best, {"params": self.params})
+                self.params = restored["params"]
+                print(f"loaded best checkpoint (step {best})")
         return self.state
+
+    def close(self):
+        self.logger.close()
 
     # ------------------------------------------------------------------ #
     # per-algo advantage assembly (host-side numpy, shapes already fixed)

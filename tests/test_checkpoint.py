@@ -1,0 +1,54 @@
+"""CheckpointManager: rotation, best-protection, `_old` one-save-back metric."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
+
+
+def params_like(v):
+    return {"w": jnp.full((2, 2), float(v))}
+
+
+def _steps(out):
+    return sorted(
+        int(d.rsplit("-", 1)[1]) for d in os.listdir(out) if d.startswith("checkpoint-")
+    )
+
+
+def test_rotation_protects_best_and_newest(tmp_path):
+    out = str(tmp_path / "ck")
+    cm = CheckpointManager(out, save_total_limit=2, greater_is_better=True)
+    # metric_old at save N scores checkpoint N-1
+    cm.save(1, params_like(1))
+    cm.save(2, params_like(2), metric_old=5.0)   # best = step 1 (5.0)
+    cm.save(3, params_like(3), metric_old=1.0)   # step 2 scores 1.0
+    cm.save(4, params_like(4), metric_old=2.0)   # step 3 scores 2.0
+    assert cm.best_step() == 1
+    steps = _steps(out)
+    assert 1 in steps            # best protected
+    assert 4 in steps            # newest protected
+    assert len(steps) <= 3       # limit 2 + protected overflow at most
+
+
+def test_save_total_limit_one_keeps_newest(tmp_path):
+    out = str(tmp_path / "ck1")
+    cm = CheckpointManager(out, save_total_limit=1, greater_is_better=True)
+    cm.save(1, params_like(1))
+    cm.save(2, params_like(2), metric_old=5.0)
+    cm.save(3, params_like(3), metric_old=1.0)
+    steps = _steps(out)
+    assert 3 in steps, "the just-saved checkpoint must never be rotated away"
+    assert cm.best_step() == 1 and 1 in steps
+
+
+def test_restore_roundtrip(tmp_path):
+    out = str(tmp_path / "ck2")
+    cm = CheckpointManager(out, save_total_limit=3)
+    cm.save(7, params_like(42))
+    restored = cm.restore(7, {"params": params_like(0)})
+    np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 42.0)
+    assert cm.latest_step() == 7
+    assert cm.load_trainer_state(7)["step"] == 7
