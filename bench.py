@@ -27,7 +27,6 @@ def main():
     import jax.numpy as jnp
 
     from nanorlhf_tpu.core import ModelConfig, init_params
-    from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params
     from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
     from nanorlhf_tpu.parallel import MeshConfig
     from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
@@ -94,7 +93,10 @@ def main():
 
     steady = times[1:] if len(times) > 1 else times
     sec_per_update = float(np.mean(steady))
-    eps_per_sec_per_chip = n_prompts / sec_per_update / n_dev
+    # cfg.batch_size (set by finalize inside RLTrainer) is the TRUE episode
+    # count per update; n_prompts may round down on non-divisible device counts
+    episodes_per_update = cfg.batch_size
+    eps_per_sec_per_chip = episodes_per_update / sec_per_update / n_dev
 
     baseline_eps_per_sec = 1.0  # reference: ~1 s/episode on one A100 40G
     print(json.dumps({
@@ -104,7 +106,7 @@ def main():
         "vs_baseline": round(eps_per_sec_per_chip / baseline_eps_per_sec, 4),
         "detail": {
             "model": model_name,
-            "prompts_per_update": n_prompts,
+            "prompts_per_update": episodes_per_update,
             "sample_n": sample_n,
             "response_length": response_len,
             "devices": n_dev,

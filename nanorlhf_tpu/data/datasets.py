@@ -76,6 +76,30 @@ def synthetic_prompts(n: int, tokenizer, seed: int = 0, min_words: int = 4,
     return prompts
 
 
+def _load_hf_dataset(name: str, split: str):
+    """Local HF cache first (fast, no network retries); fall back to a normal
+    online load when the cache misses. The offline env flip is scoped and
+    restored — it must not leak into later hub/transformers calls."""
+    import os
+
+    import datasets
+
+    saved = {k: os.environ.get(k) for k in ("HF_HUB_OFFLINE", "HF_DATASETS_OFFLINE")}
+    try:
+        os.environ["HF_HUB_OFFLINE"] = "1"
+        os.environ["HF_DATASETS_OFFLINE"] = "1"
+        return datasets.load_dataset(name, split=split)
+    except Exception:
+        pass
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return datasets.load_dataset(name, split=split)  # online attempt
+
+
 def load_prompt_dataset(
     name: str,
     tokenizer,
@@ -94,9 +118,7 @@ def load_prompt_dataset(
         _, _, count = name.partition(":")
         texts = synthetic_prompts(int(count) if count else 512, tokenizer, seed)
     else:
-        import datasets  # requires local cache in zero-egress builds
-
-        ds = datasets.load_dataset(name, split=split)
+        ds = _load_hf_dataset(name, split)
         texts = [extract_hh_question(row["chosen"]) for row in ds]
 
     if limit:

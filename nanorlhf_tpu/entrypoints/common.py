@@ -1,0 +1,91 @@
+"""Shared launcher plumbing: model/tokenizer/dataset/reward resolution.
+
+The reference keeps "ALL setting is on the file you run" (`README.md:34`) —
+each launcher is a config literal plus loading code. These helpers keep the
+launchers that thin while handling the environments a TPU build actually
+meets: real HF checkpoints when present on disk, a fully offline demo mode
+(random-init model + toy tokenizer + synthetic prompts) otherwise, so every
+launcher runs end-to-end even with zero egress.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.core.params import load_hf_checkpoint
+from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset, load_tokenizer
+from nanorlhf_tpu.rewards import make_rule_reward
+from nanorlhf_tpu.rewards.builders import make_torch_rm_reward
+from nanorlhf_tpu.trainer import RLConfig, RLTrainer
+
+
+def resolve_model(sft_model_path: str, seed: int = 0):
+    """(ModelConfig, params, tokenizer): HF checkpoint dir → load it; else an
+    offline demo model (1.5B-shaped unless path says 'tiny')."""
+    if sft_model_path and os.path.isdir(sft_model_path):
+        config, params = load_hf_checkpoint(sft_model_path)
+        tokenizer = load_tokenizer(sft_model_path)
+        return config, params, tokenizer
+    print(f"[offline demo] '{sft_model_path}' not found locally — "
+          "random-init model + toy tokenizer")
+    tiny = "tiny" in (sft_model_path or "")
+    config = ModelConfig.qwen2_tiny(vocab_size=4096) if tiny else ModelConfig.qwen2_1_5b()
+    tokenizer = ToyTokenizer(vocab_size=min(4096, config.vocab_size))
+    params = init_params(config, jax.random.PRNGKey(seed), jnp.bfloat16)
+    return config, params, tokenizer
+
+
+def resolve_dataset(cfg: RLConfig, tokenizer, max_prompt_len: int = 256):
+    """hh-rlhf when the datasets cache has it; synthetic corpus otherwise."""
+    name = getattr(cfg, "train_dataset_name", "Anthropic/hh-rlhf")
+    try:
+        return load_prompt_dataset(name, tokenizer, max_prompt_len=max_prompt_len)
+    except Exception as e:  # zero-egress / no local cache
+        print(f"[offline demo] dataset '{name}' unavailable ({type(e).__name__}) — "
+              "synthetic prompts")
+        return load_prompt_dataset("synthetic:512", tokenizer,
+                                   max_prompt_len=max_prompt_len)
+
+
+def resolve_rm_reward(reward_model_path: str, batch_size: int = 16):
+    """Torch host-side RM when its checkpoint exists (deberta path,
+    `GRPO/grpo.py:159-198`); otherwise a rule-based stand-in so the loop
+    still runs offline."""
+    if reward_model_path and os.path.isdir(reward_model_path):
+        return make_torch_rm_reward(reward_model_path, batch_size)
+    print(f"[offline demo] reward model '{reward_model_path}' not found — "
+          "rule-based stand-in reward")
+
+    def fn(s: str, eos_token: str) -> float:
+        has_eos = 1.0 if eos_token in s else 0.0
+        words = s.split()
+        return has_eos + 0.05 * min(len(set(words)) / max(len(words), 1), 1.0)
+
+    return make_rule_reward(fn)
+
+
+def run(cfg: RLConfig, value_params_fn=None, post_build=None):
+    """Build everything and train — the tail of every launcher.
+
+    `value_params_fn(mcfg, params) -> tree` builds the value model from the
+    freshly resolved policy (PPO). `post_build(trainer, dataset, reward_func)`
+    runs before training (PPO's value-initializer phase).
+    """
+    mcfg, params, tokenizer = resolve_model(cfg.sft_model_path, cfg.seed)
+    dataset = resolve_dataset(cfg, tokenizer)
+    reward_func = resolve_rm_reward(cfg.reward_model_path)
+    value_params = value_params_fn(mcfg, params) if value_params_fn else None
+    trainer = RLTrainer(
+        cfg, mcfg, tokenizer, params, dataset, reward_func,
+        value_params=value_params,
+    )
+    if post_build is not None:
+        post_build(trainer, dataset, reward_func)
+    try:
+        return trainer.train()
+    finally:
+        trainer.close()

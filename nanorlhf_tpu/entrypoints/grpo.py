@@ -1,0 +1,50 @@
+"""GRPO launcher — config parity with `/root/reference/GRPO/grpo.py:86-155`.
+
+All settings live in this file (reference convention, `README.md:34`).
+Run: python -m nanorlhf_tpu.entrypoints.grpo
+"""
+
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.trainer import AlgoName, RLConfig
+
+
+def build_config() -> RLConfig:
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        exp_name="grpo-v1",
+        sft_model_path="Qwen/Qwen2.5-1.5B-Instruct",
+        reward_model_path="OpenAssistant/reward-model-deberta-v3-large-v2",
+        output_dir="output/grpo-v1",
+        # reference defaults (`GRPO/grpo.py:108-155`)
+        kl_coef=0.01,
+        cliprange=0.2,
+        temperature=0.9,
+        learning_rate=6e-6,
+        warmup_steps=0,
+        min_lr_rate=0.1,
+        response_length=1500,
+        per_device_train_batch_size=4,
+        gradient_accumulation_steps=8,
+        num_mini_batches=16,
+        num_ppo_epochs=1,
+        total_episodes=250000,
+        whiten_rewards=False,
+        advantage_whiten=False,   # GRPO has its own group baseline
+        sample_n=4,               # grpo_sample_N (`grpo.py:106`)
+        use_lora=True,
+        lora_r=64,
+        lora_alpha=16,
+        gradient_checkpointing=True,
+        missing_eos_penalty=None,
+        save_steps=1,
+        save_total_limit=8,
+        metric_for_best_model="eval_objective/rlhf_reward_old",
+        greater_is_better=True,
+        load_best_model_at_end=True,
+        stop_token="eos",
+    )
+    return cfg
+
+
+if __name__ == "__main__":
+    run(build_config())

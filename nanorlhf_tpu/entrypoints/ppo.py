@@ -1,0 +1,62 @@
+"""PPO launcher — parity with `/root/reference/PPO/ppo.py`: dual config
+(PPO + value-finetune), a value model initialized from the SFT model with a
+fresh score head, separate policy/value learning rates, and the one-off
+value-initializer phase before PPO proper (`ppo.py:369-380`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.core import init_score_head
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.entrypoints.grpo import build_config
+from nanorlhf_tpu.trainer import AlgoName
+from nanorlhf_tpu.trainer.value_init import ValueInitConfig, finetune_value_model
+
+
+def build_ppo_config():
+    cfg = build_config()
+    cfg.algo = AlgoName.PPO
+    cfg.exp_name = "ppo-v1"
+    cfg.output_dir = "output/ppo-v1"
+    cfg.sample_n = 1
+    # separate value-model LR (`PPO/ppo.py:118-119`)
+    cfg.value_learning_rate = 1e-5
+    cfg.cliprange_value = 0.01
+    cfg.vf_coef = 0.1
+    cfg.gamma = 1.0
+    cfg.lam = 0.95            # GAE(γ=1.0, λ=0.95) (`PPO/ppo.py:177-178`)
+    return cfg
+
+
+def make_value_params(mcfg, params):
+    """Value model = SFT backbone + fresh score head
+    (`AutoModelForSequenceClassification(num_labels=1)`, `PPO/ppo.py:280-287`)."""
+    value_params = {k: v for k, v in params.items() if k not in ("lm_head", "lora")}
+    value_params = jax.tree.map(jnp.copy, value_params)
+    value_params["score"] = init_score_head(mcfg, jax.random.PRNGKey(1))
+    return value_params
+
+
+def main(run_value_init: bool = True, value_init_cfg: ValueInitConfig | None = None):
+    cfg = build_ppo_config()
+
+    def value_init_phase(trainer, dataset, reward_func):
+        if not run_value_init:
+            return
+        vcfg = value_init_cfg or ValueInitConfig()
+        prompts = np.asarray(dataset.input_ids[: vcfg.train_data_size])
+        trainer.value_params = finetune_value_model(
+            trainer.value_params, trainer.params, trainer.ref_params,
+            reward_func, prompts, trainer.tokenizer, trainer.mcfg,
+            response_length=cfg.response_length, temperature=cfg.temperature,
+            kl_coef=cfg.kl_coef, gamma=cfg.gamma, vcfg=vcfg,
+            whiten_rewards=cfg.whiten_rewards, lora_scale=trainer.lora_scale,
+            key=jax.random.PRNGKey(cfg.seed + 2),
+        )
+
+    return run(cfg, value_params_fn=make_value_params, post_build=value_init_phase)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""RAFT launcher — parity with `/root/reference/RAFT/raft.py` (raft_sample_K,
+no cliprange/whiten fields used; best-of-K + SFT loss, SURVEY.md §2.4)."""
+
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.entrypoints.grpo import build_config
+from nanorlhf_tpu.trainer import AlgoName
+
+
+def build_raft_config():
+    cfg = build_config()
+    cfg.algo = AlgoName.RAFT
+    cfg.exp_name = "raft-v1"
+    cfg.output_dir = "output/raft-v1"
+    cfg.sample_n = 4          # raft_sample_K (`RAFT/raft.py:105`)
+    return cfg
+
+
+if __name__ == "__main__":
+    run(build_raft_config())

@@ -1,0 +1,24 @@
+"""REINFORCE launcher — parity with `/root/reference/REINFORCE/reinforce.py`.
+
+The only algorithm whose launcher defaults `advantage_whiten=True`
+(`reinforce.py:103`) — whitening is its baseline."""
+
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.entrypoints.grpo import build_config
+from nanorlhf_tpu.trainer import AlgoName
+
+
+def build_reinforce_config():
+    cfg = build_config()
+    cfg.algo = AlgoName.REINFORCE
+    cfg.exp_name = "reinforce-v1"
+    cfg.output_dir = "output/reinforce-v1"
+    cfg.sample_n = 1
+    cfg.advantage_whiten = True   # (`REINFORCE/reinforce.py:103`)
+    cfg.gamma = 1.0               # (`reinforce.py:113-114`)
+    cfg.lam = 0.95
+    return cfg
+
+
+if __name__ == "__main__":
+    run(build_reinforce_config())

@@ -1,0 +1,19 @@
+"""ReMax launcher — parity with `/root/reference/ReMax/remax.py` (n=1 plus a
+greedy baseline rollout, SURVEY.md §2.1/§2.4)."""
+
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.entrypoints.grpo import build_config
+from nanorlhf_tpu.trainer import AlgoName
+
+
+def build_remax_config():
+    cfg = build_config()
+    cfg.algo = AlgoName.REMAX
+    cfg.exp_name = "remax-v1"
+    cfg.output_dir = "output/remax-v1"
+    cfg.sample_n = 1          # single sampled rollout; baseline is greedy
+    return cfg
+
+
+if __name__ == "__main__":
+    run(build_remax_config())

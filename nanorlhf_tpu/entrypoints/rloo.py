@@ -1,0 +1,20 @@
+"""RLOO launcher — parity with `/root/reference/RLOO/rloo.py` (= grpo.py
+modulo rloo_sample_N and lam fields, SURVEY.md §2.1)."""
+
+from nanorlhf_tpu.entrypoints.common import run
+from nanorlhf_tpu.entrypoints.grpo import build_config
+from nanorlhf_tpu.trainer import AlgoName
+
+
+def build_rloo_config():
+    cfg = build_config()
+    cfg.algo = AlgoName.RLOO
+    cfg.exp_name = "rloo-v1"
+    cfg.output_dir = "output/rloo-v1"
+    cfg.sample_n = 4          # rloo_sample_N (`RLOO/rloo.py:107`)
+    cfg.lam = 0.95            # (`RLOO/rloo.py:115`)
+    return cfg
+
+
+if __name__ == "__main__":
+    run(build_rloo_config())

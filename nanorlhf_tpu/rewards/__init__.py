@@ -1,0 +1,23 @@
+from nanorlhf_tpu.rewards.math_grader import (
+    get_boxed,
+    normalize_math_answer,
+    math_answers_equal,
+    is_correct,
+    call_with_timeout,
+)
+from nanorlhf_tpu.rewards.builders import (
+    make_binary_math_reward,
+    make_rm_reward,
+    make_rule_reward,
+)
+
+__all__ = [
+    "get_boxed",
+    "normalize_math_answer",
+    "math_answers_equal",
+    "is_correct",
+    "call_with_timeout",
+    "make_binary_math_reward",
+    "make_rm_reward",
+    "make_rule_reward",
+]

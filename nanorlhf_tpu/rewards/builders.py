@@ -1,0 +1,128 @@
+"""Reward-function builders implementing the reference's reward protocol.
+
+Protocol (`/root/reference/GRPO/grpo.py:162`): a callable
+`reward_func(pmt_and_responses: list[str], eos_token: str) -> array[B]`.
+The reward model is *user-pluggable by design* (`README.md:12`); these
+builders cover the three families the reference ships:
+
+- rule-based closures (r1's binary correctness, `grpo_r1.py:250-273`)
+- RM-based scoring with a JAX sequence-classifier running on the TPU mesh
+- RM-based scoring with a host-side torch model (the deberta path,
+  `GRPO/grpo.py:159-198`) when torch weights are available locally
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+def make_rule_reward(fn: Callable[[str, str], float]):
+    """Lift a per-string scoring fn into the reward protocol."""
+
+    def reward_func(pmt_and_responses, eos_token):
+        return np.asarray([fn(s, eos_token) for s in pmt_and_responses], np.float32)
+
+    return reward_func
+
+
+def make_binary_math_reward(
+    question_to_answer: dict,
+    extract_question: Callable[[str], str],
+    extract_solution: Callable[[str, str], str],
+    timeout: float = 0.05,
+    use_subprocess: bool = True,
+):
+    """r1-style binary reward: 1 if the boxed answer grades correct, else 0.
+
+    `question_to_answer` is the train-set hash map (`grpo_r1.py:237-240`);
+    the extractors recover the question and the model's boxed solution from
+    the concatenated prompt+response string (`grpo_r1.py:250-273`).
+    """
+    from nanorlhf_tpu.rewards.math_grader import get_boxed, is_correct
+
+    def reward_func(pmt_and_responses, eos_token):
+        rewards = np.zeros(len(pmt_and_responses), np.float32)
+        for i, s in enumerate(pmt_and_responses):
+            question = extract_question(s)
+            gt = question_to_answer.get(question)
+            if gt is None:
+                continue
+            solution = get_boxed(extract_solution(s, eos_token))
+            if is_correct(solution, gt, timeout=timeout, use_subprocess=use_subprocess):
+                rewards[i] = 1.0
+        return rewards
+
+    return reward_func
+
+
+def make_rm_reward(
+    rm_params: dict,
+    model_config,
+    tokenizer,
+    batch_size: int = 16,
+    max_len: int = 2048,
+):
+    """TPU-native RM reward: a JAX decoder + score head rates each string.
+
+    Scores at the last real token (TRL `get_reward` semantics, used at
+    `PPO/ppo_trainer.py:630-634`). Batched at `reward_batch_size` parity
+    (`GRPO/grpo.py:97,189-192`). Unlike the reference there is no CPU↔GPU
+    RM migration (`grpo.py:164,195`) — the RM tree lives in HBM alongside
+    the policy.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core.model import score_forward
+
+    pad_id = tokenizer.pad_token_id
+
+    @jax.jit
+    def score_batch(params, ids):
+        scores = score_forward(params, model_config, ids, pad_id)[:, :, 0]
+        mask = ids != pad_id
+        last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)
+        return scores[jnp.arange(ids.shape[0]), last]
+
+    def reward_func(pmt_and_responses, eos_token):
+        out = []
+        for i in range(0, len(pmt_and_responses), batch_size):
+            chunk = pmt_and_responses[i : i + batch_size]
+            enc = [tokenizer.encode(s)[:max_len] for s in chunk]
+            width = max(len(e) for e in enc)
+            ids = np.full((len(enc), width), pad_id, np.int32)
+            for j, e in enumerate(enc):
+                ids[j, : len(e)] = e  # right-pad; scorer finds last real token
+            out.append(np.asarray(score_batch(rm_params, jnp.asarray(ids))))
+        return np.concatenate(out).astype(np.float32)
+
+    return reward_func
+
+
+def make_torch_rm_reward(model_path: str, batch_size: int = 16, device: str = "cpu"):
+    """Host-side torch RM (the deberta-v3 path, `GRPO/grpo.py:159-198`).
+
+    Runs on CPU next to the TPU loop; use when the RM checkpoint is a torch
+    encoder with its own tokenizer. Requires local weights (zero-egress).
+    """
+    import torch
+    from transformers import AutoModelForSequenceClassification, AutoTokenizer
+
+    model = AutoModelForSequenceClassification.from_pretrained(model_path).eval().to(device)
+    rm_tok = AutoTokenizer.from_pretrained(model_path)
+
+    def reward_func(pmt_and_responses, eos_token):
+        out = []
+        with torch.no_grad():
+            for i in range(0, len(pmt_and_responses), batch_size):
+                chunk = [s.replace(eos_token, "") for s in
+                         pmt_and_responses[i : i + batch_size]]
+                enc = rm_tok(chunk, return_tensors="pt", padding=True,
+                             truncation=True, max_length=2048).to(device)
+                logits = model(**enc).logits[:, 0]
+                out.append(logits.float().cpu().numpy())
+        return np.concatenate(out).astype(np.float32)
+
+    return reward_func

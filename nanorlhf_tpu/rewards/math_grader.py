@@ -1,0 +1,247 @@
+"""Math-answer grading: boxed extraction, normalization, equivalence.
+
+Fresh implementation of the capability the reference gets from its vendored
+DeepSeek/Qwen toolkits (`/root/reference/examples/r1-v0/utils/
+{toolkit_for_MATH,eval}/**`) and the r1 launcher's graders
+(`examples/r1-v0/grpo_r1.py:179-224`):
+
+- `get_boxed`: brace-matched \\boxed{...} extraction;
+- `normalize_math_answer`: MATH-style latex normalization;
+- `math_answers_equal`: string → numeric → sympy-symbolic equivalence ladder;
+- `call_with_timeout`: run a grader in a killable subprocess so adversarial
+  expressions (e.g. 2^(2^100000)) cannot stall training — the reference's
+  timeout-subprocess pattern, host-side next to the TPU loop.
+
+Everything here is pure Python/sympy on the host; nothing enters the
+compiled graph.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import re
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def get_boxed(text: str) -> str:
+    """Contents of the first \\boxed{...}, with nested braces matched.
+
+    Returns "" when absent — callers treat that as wrong
+    (`grpo_r1.py:194-213,216-218`). Whitespace stripped like the reference.
+    """
+    pos = text.find("boxed{")
+    if pos == -1:
+        return ""
+    body = text[pos + len("boxed{"):]
+    depth = 1
+    for i, ch in enumerate(body):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return body[:i].replace(" ", "")
+    return ""  # unbalanced braces
+
+
+# ---------------------------------------------------------------------------
+# normalization (MATH-style latex surface cleanup)
+# ---------------------------------------------------------------------------
+
+_TEXT_CMDS = ("\\text", "\\mbox", "\\textbf", "\\mathrm", "\\mathbf")
+
+
+def _strip_cmd_wrapper(s: str, cmd: str) -> str:
+    """Replace cmd{X} with X (single level, repeatedly)."""
+    while True:
+        pos = s.find(cmd + "{")
+        if pos == -1:
+            return s
+        depth, start = 1, pos + len(cmd) + 1
+        for i in range(start, len(s)):
+            if s[i] == "{":
+                depth += 1
+            elif s[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    s = s[:pos] + s[start:i] + s[i + 1:]
+                    break
+        else:
+            return s
+
+
+def normalize_math_answer(ans: str) -> str:
+    """Canonicalize a latex answer string for surface comparison."""
+    s = ans.strip()
+    # outer $ ... $ / \( ... \)
+    s = s.strip("$")
+    s = s.replace("\\(", "").replace("\\)", "").replace("\\[", "").replace("\\]", "")
+    s = s.replace("\\left", "").replace("\\right", "")
+    s = s.replace("\\!", "").replace("\\,", "").replace("\\;", "").replace("\\:", "")
+    s = s.replace("\\$", "").replace("\\%", "").replace("%", "")
+    for cmd in _TEXT_CMDS:
+        s = _strip_cmd_wrapper(s, cmd)
+    s = s.replace("^{\\circ}", "").replace("^\\circ", "")
+    s = s.replace("\\cdot", "*").replace("\\times", "*")
+    # \tfrac/\dfrac -> \frac
+    s = s.replace("\\tfrac", "\\frac").replace("\\dfrac", "\\frac")
+    # \frac ab / \frac{a}b / \frac a{b} -> \frac{a}{b}
+    s = re.sub(r"\\frac\s*([0-9a-zA-Z])\s*([0-9a-zA-Z])", r"\\frac{\1}{\2}", s)
+    s = re.sub(r"\\frac\{([^{}]*)\}\s*([0-9a-zA-Z])", r"\\frac{\1}{\2}", s)
+    s = re.sub(r"\\frac\s*([0-9a-zA-Z])\s*\{", r"\\frac{\1}{", s)
+    # \sqrt x -> \sqrt{x}
+    s = re.sub(r"\\sqrt\s*([0-9a-zA-Z])", r"\\sqrt{\1}", s)
+    # drop trailing units-ish words after a number, thousands separators
+    s = s.replace(",\\!", "").replace("{,}", "")
+    s = re.sub(r"(?<=\d),(?=\d{3}\b)", "", s)
+    # leading "x=" style assignment
+    s = re.sub(r"^[a-zA-Z]\s*=\s*", "", s)
+    # 0.5 -> .5 canonicalization (match MATH convention: strip leading 0)
+    s = re.sub(r"(?<![\d.])0\.(\d)", r".\1", s)
+    s = s.replace(" ", "")
+    # trailing period
+    s = s.rstrip(".")
+    return s
+
+
+# ---------------------------------------------------------------------------
+# equivalence ladder
+# ---------------------------------------------------------------------------
+
+
+def _latex_to_sympy_str(s: str) -> str:
+    """Light latex → sympy-parsable conversion for common answer shapes."""
+    out = s
+    # \frac{a}{b} -> (a)/(b), applied repeatedly for nesting
+    frac = re.compile(r"\\frac\{([^{}]*)\}\{([^{}]*)\}")
+    while frac.search(out):
+        out = frac.sub(r"((\1)/(\2))", out)
+    sqrt = re.compile(r"\\sqrt\{([^{}]*)\}")
+    while sqrt.search(out):
+        out = sqrt.sub(r"sqrt(\1)", out)
+    out = out.replace("\\pi", "pi").replace("\\infty", "oo")
+    out = out.replace("^", "**")
+    out = out.replace("{", "(").replace("}", ")")
+    out = out.replace("\\", "")
+    return out
+
+
+def _try_float(s: str):
+    try:
+        return float(s)
+    except (ValueError, TypeError):
+        return None
+
+
+def _numeric_equal(a: str, b: str, tol: float = 1e-6) -> bool | None:
+    fa, fb = _try_float(a), _try_float(b)
+    if fa is None or fb is None:
+        return None
+    return abs(fa - fb) <= tol * max(1.0, abs(fa), abs(fb))
+
+
+def _sympy_equal(a: str, b: str) -> bool:
+    """Symbolic equality via sympy; exceptions mean 'not provably equal'."""
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import (
+            implicit_multiplication_application,
+            parse_expr,
+            standard_transformations,
+        )
+
+        transforms = standard_transformations + (implicit_multiplication_application,)
+        ea = parse_expr(_latex_to_sympy_str(a), transformations=transforms)
+        eb = parse_expr(_latex_to_sympy_str(b), transformations=transforms)
+        diff = sympy.simplify(ea - eb)
+        return diff == 0
+    except Exception:
+        return False
+
+
+def math_answers_equal(pred: str, gt: str) -> bool:
+    """String match → normalized match → tuple/interval recurse → numeric →
+    sympy symbolic. No subprocess here — wrap in call_with_timeout for that."""
+    if pred is None or gt is None:
+        return False
+    if pred.strip() == gt.strip():
+        return True
+    a, b = normalize_math_answer(pred), normalize_math_answer(gt)
+    if a == b:
+        return True
+    if not a or not b:
+        return False
+    # tuples/intervals: compare element-wise when separators match
+    if (a[0], a[-1]) in {("(", ")"), ("[", "]")} and (b[0], b[-1]) == (a[0], a[-1]) \
+            and "," in a and "," in b:
+        pa, pb = a[1:-1].split(","), b[1:-1].split(",")
+        if len(pa) == len(pb):
+            return all(math_answers_equal(x, y) for x, y in zip(pa, pb))
+    num = _numeric_equal(a, b)
+    if num is not None:
+        return num
+    return _sympy_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# timeout guard
+# ---------------------------------------------------------------------------
+
+
+def _grade_worker(pred, gt, q):
+    try:
+        q.put(math_answers_equal(pred, gt))
+    except Exception:
+        q.put(False)
+
+
+def _ensure_sympy_loaded():
+    """Import sympy in the parent once, so forked grader children inherit the
+    loaded module instead of paying a multi-second import inside their tiny
+    timeout budget (the reference's 0.015 s only works because its parent
+    imported the toolkit at module load)."""
+    import sympy  # noqa: F401
+    import sympy.parsing.sympy_parser  # noqa: F401
+
+
+def call_with_timeout(func, *args, timeout: float = 0.5):
+    """Run func(*args, queue) in a forked subprocess; False on timeout or
+    exception.
+
+    Same contract as the reference's guard (`grpo_r1.py:179-192`): the child
+    receives an extra Queue argument and must put its result there. join +
+    terminate bounds the wait even if the fork deadlocks under a threaded
+    parent.
+    """
+    _ensure_sympy_loaded()
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=func, args=args + (q,))
+    p.start()
+    p.join(timeout)
+    if p.is_alive():
+        p.terminate()
+        p.join()
+        return False
+    try:
+        return q.get(timeout=0.1)
+    except Exception:
+        return False
+
+
+def is_correct(pred: str, gt: str, timeout: float = 0.5, use_subprocess: bool = True) -> bool:
+    """Full grader: exact match fast path, then timeout-guarded equivalence.
+
+    `iscorrect` parity (`grpo_r1.py:216-224`). `use_subprocess=False` runs
+    in-process (tests / trusted inputs; much faster on 1-core hosts).
+    """
+    if not pred:
+        return False
+    if pred.strip() == gt.strip():
+        return True
+    if use_subprocess:
+        return bool(call_with_timeout(_grade_worker, pred, gt, timeout=timeout))
+    return math_answers_equal(pred, gt)

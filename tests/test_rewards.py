@@ -1,0 +1,126 @@
+"""Math grader + reward builders."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nanorlhf_tpu.rewards import (
+    get_boxed,
+    normalize_math_answer,
+    math_answers_equal,
+    is_correct,
+    make_binary_math_reward,
+    make_rm_reward,
+    make_rule_reward,
+)
+
+
+class TestGetBoxed:
+    def test_simple(self):
+        assert get_boxed(r"the answer is \boxed{42}") == "42"
+
+    def test_nested_braces(self):
+        assert get_boxed(r"\boxed{\frac{1}{2}}") == r"\frac{1}{2}"
+
+    def test_missing(self):
+        assert get_boxed("no box here") == ""
+
+    def test_unbalanced(self):
+        assert get_boxed(r"\boxed{\frac{1}{2}") == ""
+
+    def test_strips_spaces(self):
+        assert get_boxed(r"\boxed{1 + 1}") == "1+1"
+
+
+class TestNormalize:
+    @pytest.mark.parametrize(
+        "raw,want",
+        [
+            (r"\frac12", r"\frac{1}{2}"),
+            (r"\tfrac{1}{2}", r"\frac{1}{2}"),
+            (r"\left(1,2\right)", "(1,2)"),
+            (r"\text{cm}", "cm"),
+            ("50\\%", "50"),
+            ("$12$", "12"),
+            ("1,000,000", "1000000"),
+            ("x = 5", "5"),
+            ("0.5", ".5"),
+            (r"90^\circ", "90"),
+        ],
+    )
+    def test_cases(self, raw, want):
+        assert normalize_math_answer(raw) == want
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize(
+        "a,b",
+        [
+            ("42", "42"),
+            ("0.5", "1/2"),
+            (r"\frac{1}{2}", "0.5"),
+            (r"\frac{2}{4}", r"\frac{1}{2}"),
+            (r"\sqrt{4}", "2"),
+            ("2*pi", r"2\pi"),
+            ("(1,2)", r"\left(1, 2\right)"),
+            ("1000000", "1,000,000"),
+            ("x=3", "3"),
+            ("2^3", "8"),
+        ],
+    )
+    def test_equal(self, a, b):
+        assert math_answers_equal(a, b)
+
+    @pytest.mark.parametrize(
+        "a,b",
+        [("42", "43"), (r"\frac{1}{2}", r"\frac{1}{3}"), ("(1,2)", "(2,1)"), ("", "5")],
+    )
+    def test_not_equal(self, a, b):
+        assert not math_answers_equal(a, b)
+
+    def test_is_correct_subprocess_survives_bomb(self):
+        # adversarial: enormous power tower must time out to False, not hang
+        assert is_correct("2**(2**(2**100000))", "5", timeout=0.2) is False
+
+    def test_is_correct_inprocess(self):
+        assert is_correct("1/2", "0.5", use_subprocess=False)
+
+
+def test_binary_math_reward():
+    qa = {"What is 2+2?": "4"}
+
+    def extract_q(s):
+        return s.split("\n")[0]
+
+    def extract_sol(s, eos):
+        return s.split("\n", 1)[1] if "\n" in s else ""
+
+    reward = make_binary_math_reward(qa, extract_q, extract_sol, use_subprocess=False)
+    got = reward(
+        ["What is 2+2?\nI think \\boxed{4}", "What is 2+2?\n\\boxed{5}",
+         "Unknown question\n\\boxed{4}"],
+        "</s>",
+    )
+    np.testing.assert_array_equal(got, [1.0, 0.0, 0.0])
+
+
+def test_rule_reward():
+    reward = make_rule_reward(lambda s, eos: float(len(s)))
+    np.testing.assert_array_equal(reward(["ab", "abcd"], "</s>"), [2.0, 4.0])
+
+
+def test_rm_reward_jax():
+    from nanorlhf_tpu.core import ModelConfig, init_params, init_score_head
+    from nanorlhf_tpu.data import ToyTokenizer
+
+    tok = ToyTokenizer(256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    rm = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    rm.pop("lm_head", None)
+    rm["score"] = init_score_head(mcfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    reward = make_rm_reward(rm, mcfg, tok, batch_size=2)
+    got = reward(["hello world", "goodbye cruel world", "a b c"], "</s>")
+    assert got.shape == (3,) and np.all(np.isfinite(got))
+    # deterministic
+    np.testing.assert_allclose(got, reward(["hello world", "goodbye cruel world", "a b c"], "</s>"))
