@@ -78,25 +78,35 @@ def synthetic_prompts(n: int, tokenizer, seed: int = 0, min_words: int = 4,
 
 def _load_hf_dataset(name: str, split: str):
     """Local HF cache first (fast, no network retries); fall back to a normal
-    online load when the cache misses. The offline env flip is scoped and
-    restored — it must not leak into later hub/transformers calls."""
-    import os
+    online load when the cache misses.
 
+    The offline switch must flip the already-imported module constants —
+    `huggingface_hub`/`datasets` read HF_HUB_OFFLINE from the environment at
+    *import* time, so env vars alone do nothing once they're loaded. Scoped
+    and restored: it must not leak into later hub/transformers calls.
+    """
     import datasets
+    import datasets.config as dcfg
+    import huggingface_hub.constants as hub_c
+    from huggingface_hub.utils import reset_sessions
 
-    saved = {k: os.environ.get(k) for k in ("HF_HUB_OFFLINE", "HF_DATASETS_OFFLINE")}
+    # datasets < 2.19 has no HF_HUB_OFFLINE attribute; fall back to the older
+    # HF_DATASETS_OFFLINE name so the attribute write targets what exists
+    dcfg_attr = "HF_HUB_OFFLINE" if hasattr(dcfg, "HF_HUB_OFFLINE") else "HF_DATASETS_OFFLINE"
+    saved = (hub_c.HF_HUB_OFFLINE, getattr(dcfg, dcfg_attr, False))
     try:
-        os.environ["HF_HUB_OFFLINE"] = "1"
-        os.environ["HF_DATASETS_OFFLINE"] = "1"
+        hub_c.HF_HUB_OFFLINE = True
+        setattr(dcfg, dcfg_attr, True)
+        reset_sessions()  # drop cached sessions so they re-read the flag
         return datasets.load_dataset(name, split=split)
     except Exception:
         pass
     finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+        hub_c.HF_HUB_OFFLINE = saved[0]
+        setattr(dcfg, dcfg_attr, saved[1])
+        # sessions created during the offline window baked in OfflineAdapter;
+        # reset again so post-restore hub calls get fresh online sessions
+        reset_sessions()
     return datasets.load_dataset(name, split=split)  # online attempt
 
 

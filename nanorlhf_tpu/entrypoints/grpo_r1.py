@@ -1,0 +1,187 @@
+"""R1-Zero launcher — sparse GRPO on math reasoning, parity with
+`/root/reference/examples/r1-v0/grpo_r1.py`.
+
+Base (non-instruct) model, MetaMathQA training prompts / MATH-500 eval,
+binary boxed-answer reward, response_length 8000 with kl_coef 0.0
+(`grpo_r1.py:92,126-128,138,145`), greedy accuracy eval before training and
+every `eval_steps` updates (`grpo_r1_trainer.py:471-475,824-825`). Offline
+builds fall back to a synthetic arithmetic corpus so the full sparse-GRPO
+path still runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.data.datasets import PromptDataset, _left_pad
+from nanorlhf_tpu.entrypoints.common import resolve_model
+from nanorlhf_tpu.rewards import get_boxed, is_correct
+from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.trainer import AlgoName, RLConfig
+from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
+
+# the reference's math prompt template (`grpo_r1.py:228`)
+TEMPLATE = (
+    "# Question:\nQUESTION\nPlease reason step by step, and put your final "
+    "answer within \\boxed{}.\n# Answer:\n"
+)
+
+
+def build_config() -> RLConfig:
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        exp_name="grpo-r1-v0",
+        sft_model_path="Qwen/Qwen2-1.5B",        # base model (`grpo_r1.py:92`)
+        output_dir="output/grpo-r1-v0",
+        response_length=8000,                     # (`grpo_r1.py:145`)
+        kl_coef=0.0,                              # (`grpo_r1.py:138`)
+        temperature=0.9,
+        sample_n=4,
+        learning_rate=6e-6,
+        per_device_train_batch_size=4,
+        gradient_accumulation_steps=8,
+        num_mini_batches=16,
+        total_episodes=250000,
+        use_lora=True,
+        lora_r=64,
+        lora_alpha=16,
+        eval_steps=10,                            # accuracy every 10 steps
+        save_steps=1,
+        save_total_limit=8,
+    )
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# datasets: MetaMathQA / MATH-500, synthetic arithmetic fallback
+# ---------------------------------------------------------------------------
+
+
+def synthetic_math_corpus(n: int, seed: int = 0):
+    """Offline stand-in: single-step arithmetic with known boxed answers."""
+    rng = np.random.default_rng(seed)
+    qa = []
+    for _ in range(n):
+        a, b = int(rng.integers(2, 99)), int(rng.integers(2, 99))
+        op = rng.choice(["+", "-", "*"])
+        ans = {"+": a + b, "-": a - b, "*": a * b}[op]
+        qa.append((f"What is {a} {op} {b}?", str(ans)))
+    return qa
+
+
+def load_math_datasets(train_name: str, eval_name: str, limit: int | None = None):
+    """(train_qa, eval_qa) as lists of (question, boxed_answer)."""
+    try:
+        from nanorlhf_tpu.data.datasets import _load_hf_dataset
+
+        train = _load_hf_dataset(train_name, "train")
+        train_qa = []
+        for row in train:
+            resp = row["response"]
+            marker = "The answer is: "
+            i = resp.find(marker)
+            if i != -1:
+                train_qa.append((row["query"], resp[i + len(marker):].strip()))
+        ev = _load_hf_dataset(eval_name, "test")
+        eval_qa = [(row["problem"], get_boxed(row["solution"])) for row in ev]
+        if limit:
+            train_qa, eval_qa = train_qa[:limit], eval_qa[: min(limit, 500)]
+        return train_qa, eval_qa
+    except Exception as e:
+        print(f"[offline demo] math datasets unavailable ({type(e).__name__}) — "
+              "synthetic arithmetic corpus")
+        return synthetic_math_corpus(512), synthetic_math_corpus(64, seed=1)
+
+
+def build_prompt_dataset(train_qa, tokenizer, max_prompt_len: int = 512):
+    texts = [TEMPLATE.replace("QUESTION", q) for q, _ in train_qa]
+    ids = [tokenizer.encode(t)[:max_prompt_len] for t in texts]
+    return PromptDataset(_left_pad(ids, tokenizer.pad_token_id), tokenizer.pad_token_id)
+
+
+# ---------------------------------------------------------------------------
+# reward + accuracy (r1 protocol)
+# ---------------------------------------------------------------------------
+
+
+def make_r1_reward(train_index: dict, use_subprocess: bool = True):
+    """Binary reward via the r1 signature
+    `(pmt_and_responses, responses_ids, tokenizer)` (`grpo_r1.py:250-273`)."""
+
+    def reward_func(pmt_and_responses, responses_ids, tokenizer):
+        rewards = np.zeros(len(pmt_and_responses), np.float32)
+        for i, s in enumerate(pmt_and_responses):
+            q_start = len("# Question:\n")
+            q_end = s.find("\nPlease reason step by step, and")
+            if q_end == -1:
+                continue
+            question = s[q_start:q_end]
+            a_idx = s.find("\n# Answer:\n", q_end)
+            if a_idx == -1:
+                continue
+            solution = s[a_idx + len("\n# Answer:\n"):]
+            end = solution.find(tokenizer.eos_token)
+            if end != -1:
+                solution = solution[:end]
+            gt = train_index.get(question)
+            if gt is None:
+                continue
+            if is_correct(get_boxed(solution), gt, use_subprocess=use_subprocess):
+                rewards[i] = 1.0
+        return rewards
+
+    return reward_func
+
+
+def make_accuracy_func(eval_qa, max_prompt_len: int = 512,
+                       eval_response_length: int = 1024,
+                       use_subprocess: bool = True, batch: int = 64):
+    """Greedy-decode accuracy on the eval set (`grpo_r1.py:276-341`)."""
+
+    def accuracy_func(trainer) -> float:
+        tok = trainer.tokenizer
+        texts = [TEMPLATE.replace("QUESTION", q) for q, _ in eval_qa]
+        ids = _left_pad([tok.encode(t)[:max_prompt_len] for t in texts],
+                        tok.pad_token_id)
+        correct = 0
+        for i in range(0, len(eval_qa), batch):
+            chunk = jnp.asarray(ids[i : i + batch])
+            out = generate(
+                trainer.params, trainer.mcfg, chunk,
+                chunk != tok.pad_token_id, jax.random.PRNGKey(0),
+                SamplingParams(greedy=True, max_tokens=eval_response_length),
+                eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+                lora_scale=trainer.lora_scale,
+            )
+            for row, (_, gt) in zip(np.asarray(out), eval_qa[i : i + batch]):
+                text = tok.decode(row, skip_special_tokens=True)
+                if is_correct(get_boxed(text), gt, use_subprocess=use_subprocess):
+                    correct += 1
+        return correct / max(len(eval_qa), 1)
+
+    return accuracy_func
+
+
+def main(cfg: RLConfig | None = None, limit: int | None = None):
+    cfg = cfg or build_config()
+    mcfg, params, tokenizer = resolve_model(cfg.sft_model_path, cfg.seed)
+    train_qa, eval_qa = load_math_datasets("meta-math/MetaMathQA", "HuggingFaceH4/MATH-500",
+                                           limit=limit)
+    train_index = dict(train_qa)
+    dataset = build_prompt_dataset(train_qa, tokenizer)
+    trainer = SparseGRPOTrainer(
+        cfg, mcfg, tokenizer, params, dataset,
+        make_r1_reward(train_index),
+        accuracy_func=make_accuracy_func(eval_qa),
+    )
+    try:
+        return trainer.train()
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()

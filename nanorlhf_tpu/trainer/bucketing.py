@@ -1,0 +1,78 @@
+"""Length-bucketed dynamic batching under static XLA shapes.
+
+The reference's r1 trainer packs samples into padding-waste-aware buckets with
+a memory-budget model `max_len × (count+1) ≤ budget`
+(`/root/reference/examples/r1-v0/grpo_r1_trainer.py:410-435`) and de-pads
+between phases (`:571-582`). On GPU every bucket shape is free; under XLA each
+new shape is a compile. The TPU twist here: bucket *boundary* lengths and row
+counts are rounded up to a small menu (powers of two), so across updates the
+compile cache stays warm while padding waste stays bounded (< 2×, typically
+~1.3×) — design inversion #3 of SURVEY.md §7.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def create_batches(lengths, max_batch_memory_size: int) -> list[list[int]]:
+    """Greedy length-sorted packing: fill a bucket while
+    max(cur_len, next_len) × (count+1) ≤ budget.
+
+    Exact packing semantics of `_create_batches`
+    (`grpo_r1_trainer.py:410-435`); returns index lists into `lengths`.
+    """
+    lengths = np.asarray(lengths)
+    order = np.argsort(lengths, kind="stable")
+    batches: list[list[int]] = []
+    current: list[int] = []
+    cur_len = 0
+    for idx in order:
+        sample_len = int(lengths[idx])
+        future = max(cur_len, sample_len) * (len(current) + 1)
+        if future > max_batch_memory_size and current:
+            batches.append(current)
+            current = []
+            cur_len = 0
+        current.append(int(idx))
+        cur_len = max(cur_len, sample_len)
+    if current:
+        batches.append(current)
+    return batches
+
+
+def shape_menu(max_value: int, min_value: int = 16) -> list[int]:
+    """Powers of two from min_value up, capped at (and including) max_value."""
+    menu = []
+    v = min_value
+    while v < max_value:
+        menu.append(v)
+        v *= 2
+    menu.append(max_value)
+    return menu
+
+
+def round_up_to_menu(value: int, menu: list[int]) -> int:
+    """Smallest menu entry ≥ value (menu assumed sorted ascending)."""
+    for m in menu:
+        if m >= value:
+            return m
+    return menu[-1]
+
+
+def pad_rows(arrays: dict, n_rows: int, fill: dict):
+    """Pad each [B, ...] array in `arrays` to n_rows with fill values.
+
+    Dummy rows are fully masked downstream, so their content only has to be
+    shape-compatible (e.g. all-pad token rows, zero advantages).
+    """
+    out = {}
+    for k, v in arrays.items():
+        v = np.asarray(v)
+        if v.shape[0] == n_rows:
+            out[k] = v
+            continue
+        pad_shape = (n_rows - v.shape[0],) + v.shape[1:]
+        filler = np.full(pad_shape, fill.get(k, 0), dtype=v.dtype)
+        out[k] = np.concatenate([v, filler], axis=0)
+    return out

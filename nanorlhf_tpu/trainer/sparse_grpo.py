@@ -1,0 +1,376 @@
+"""Sparse GRPO — the long-sequence (8k-token) trainer variant of r1-v0.
+
+Re-states `/root/reference/examples/r1-v0/grpo_r1_trainer.py` on the unified
+runtime. The four moves that let the reference train 8,000-token responses on
+one 40 GB GPU (`examples/r1-v0/README.md:25-28`), here under XLA static
+shapes:
+
+1. **sparse filter** — drop samples whose z-scored advantage is 0 (with 0/1
+   rewards that's every all-correct/all-wrong group) (`:565-568`);
+2. **de-padding** — strip the common left-pad of queries and truncate
+   responses to the batch max (`:571-582`), rounded onto a power-of-two menu
+   so XLA's compile cache stays warm;
+3. **bucket batching** — pack by length under the `max_len × rows ≤ budget`
+   memory model, rollout budget 22·2316 / backward budget 4·2316
+   (`:589,700,410-435`);
+4. **bucket-scaled loss** — each bucket backward is scaled
+   `rows / minibatch_rows`, one optimizer step per minibatch (`:786-791`).
+
+Host-side numpy handles all ragged filtering/packing; jit only ever sees the
+menu shapes (SURVEY.md §7 hard part #2).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from nanorlhf_tpu.algos import (
+    discounted_returns,
+    grpo_group_advantage,
+    keep_one_of_n_indices,
+    sparse_terminal_rewards,
+)
+from nanorlhf_tpu.algos.losses import grpo_loss
+from nanorlhf_tpu.ops.masking import (
+    INVALID_LOGPROB,
+    first_true_indices,
+    logprobs_from_logits,
+    response_padding_masks,
+    truncate_response,
+)
+from nanorlhf_tpu.core.model import padded_forward_logits
+from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.trainer.bucketing import (
+    create_batches,
+    pad_rows,
+    round_up_to_menu,
+    shape_menu,
+)
+from nanorlhf_tpu.trainer.trainer import RLTrainer
+
+ROLLOUT_BUDGET = 22 * 2316   # forward memory model (`grpo_r1_trainer.py:589`)
+BACKWARD_BUDGET = 4 * 2316   # backward memory model (`grpo_r1_trainer.py:700`)
+
+
+class SparseGRPOTrainer(RLTrainer):
+    """GRPO + sparse filtering + bucketed variable-length execution.
+
+    `accuracy_func(trainer) -> float`, when given, runs before training and
+    every `cfg.eval_steps` updates (MATH-500 greedy eval in r1,
+    `grpo_r1_trainer.py:471-475,824-825`).
+
+    The reward callable may use either protocol:
+    `(pmt_and_responses, eos_token)` or the r1 signature
+    `(pmt_and_responses, responses_ids, tokenizer)` (`grpo_r1.py:250`).
+    """
+
+    def __init__(self, *args, accuracy_func: Optional[Callable] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.accuracy_func = accuracy_func
+        self._len_menu = shape_menu(
+            self.cfg.response_length + self.dataset.input_ids.shape[1], min_value=32
+        )
+        self._rows_menu = shape_menu(max(self.cfg.batch_size, 1), min_value=1)
+
+    # ------------------------------------------------------------------ #
+    # jitted pieces (bucket-shaped)
+    # ------------------------------------------------------------------ #
+
+    def _bucket_score_fn(self):
+        if hasattr(self, "_bucket_score_cached"):
+            return self._bucket_score_cached
+        mcfg, cfg = self.mcfg, self.cfg
+        pad_id = self.tokenizer.pad_token_id
+        lora_scale = self.lora_scale
+
+        @partial(jax.jit, static_argnums=(3,))
+        def score(params, ref_params, qr, context_length: int):
+            resp = qr[:, context_length:]
+            lp = logprobs_from_logits(
+                padded_forward_logits(params, mcfg, qr, pad_id,
+                                      lora_scale=lora_scale)[:, context_length - 1 : -1],
+                resp, cfg.temperature,
+            )
+            rlp = logprobs_from_logits(
+                padded_forward_logits(ref_params, mcfg, qr, pad_id)[:, context_length - 1 : -1],
+                resp, cfg.temperature,
+            )
+            return lp, rlp
+
+        self._bucket_score_cached = score
+        return score
+
+    def _bucket_grad_fn(self):
+        if hasattr(self, "_bucket_grad_cached"):
+            return self._bucket_grad_cached
+        mcfg, cfg = self.mcfg, self.cfg
+        pad_id = self.tokenizer.pad_token_id
+        lora_scale = self.lora_scale
+        remat = cfg.gradient_checkpointing
+        combine = self._combine
+
+        def loss_fn(trainable, frozen, mb, context_length, loss_scale):
+            tree = combine(trainable, frozen)
+            logits = padded_forward_logits(
+                tree["policy"], mcfg, mb["query_responses"], pad_id,
+                lora_scale=lora_scale, remat=remat,
+            )[:, context_length - 1 : -1]
+            new_lp = logprobs_from_logits(logits, mb["responses"], cfg.temperature)
+            new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
+            loss, aux = grpo_loss(
+                new_lp, mb["logprobs"], mb["ref_logprobs"], mb["advantages"],
+                ~mb["padding_mask"], cfg.cliprange, cfg.kl_coef,
+            )
+            return loss * loss_scale, aux
+
+        @partial(jax.jit, static_argnums=(3,))
+        def bucket_grads(trainable, frozen, mb, context_length, loss_scale):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                trainable, frozen, mb, context_length, loss_scale
+            )
+            return grads, aux
+
+        self._bucket_grad_cached = bucket_grads
+        return bucket_grads
+
+    def _apply_grads_fn(self):
+        if hasattr(self, "_apply_grads_cached"):
+            return self._apply_grads_cached
+        optimizer = self.optimizer
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def apply_grads(trainable, opt_state, grads):
+            updates, opt_state = optimizer.update(grads, opt_state, trainable)
+            return optax.apply_updates(trainable, updates), opt_state
+
+        self._apply_grads_cached = apply_grads
+        return apply_grads
+
+    # ------------------------------------------------------------------ #
+    # reward protocol bridge
+    # ------------------------------------------------------------------ #
+
+    def _call_reward(self, pmt_and_responses, responses_ids):
+        try:
+            return np.asarray(
+                self.reward_func(pmt_and_responses, responses_ids, self.tokenizer),
+                np.float32,
+            )
+        except TypeError:
+            return np.asarray(
+                self.reward_func(pmt_and_responses, self.tokenizer.eos_token),
+                np.float32,
+            )
+
+    # ------------------------------------------------------------------ #
+    # the sparse training loop
+    # ------------------------------------------------------------------ #
+
+    def train(self, num_updates: Optional[int] = None):
+        cfg, tok = self.cfg, self.tokenizer
+        pad_id, eos_id = tok.pad_token_id, tok.eos_token_id
+        n = cfg.sample_n
+        score_fn = self._bucket_score_fn()
+        grad_fn = self._bucket_grad_fn()
+        apply_fn = self._apply_grads_fn()
+
+        if self.accuracy_func is not None and self.state["global_step"] == 0:
+            acc = float(self.accuracy_func(self))
+            self.logger.log(0, 0, {"initial_accuracy": acc})
+
+        sampling = SamplingParams(
+            temperature=cfg.temperature, top_p=cfg.top_p, n=n,
+            max_tokens=cfg.response_length,
+        )
+        n_updates = cfg.num_total_batches if num_updates is None else num_updates
+
+        for update in range(1, n_updates + 1):
+            t_start = time.time()
+            self.state["episode"] += cfg.batch_size
+            queries = np.asarray(next(self._iter))
+            batch_size = queries.shape[0]
+
+            # ---- rollout + reward -----------------------------------------
+            self.key, gk = jax.random.split(self.key)
+            q_j = jnp.asarray(queries)
+            responses = np.asarray(generate(
+                self.params, self.mcfg, q_j, q_j != pad_id, gk, sampling,
+                eos_token_id=eos_id, pad_token_id=pad_id,
+                lora_scale=self.lora_scale,
+            ))
+            question_strings = [
+                q.replace(tok.pad_token, "") for q in tok.batch_decode(queries)
+            ]
+            question_n = [q for q in question_strings for _ in range(n)]
+            decoded = tok.batch_decode(responses)
+            raw_scores = self._call_reward(
+                [q + r for q, r in zip(question_n, decoded)], responses
+            )
+            mean_raw_score = float(raw_scores.mean())
+            log_responses_length = float(
+                np.asarray(first_true_indices(jnp.asarray(responses) == pad_id)).mean()
+            )
+
+            # ---- group z-score + keep-1-of-N ------------------------------
+            adv_flat = np.asarray(grpo_group_advantage(jnp.asarray(raw_scores), n))
+            self.key, kk = jax.random.split(self.key)
+            keep = np.asarray(keep_one_of_n_indices(kk, batch_size, n))
+            rows = np.arange(batch_size)
+            scores = adv_flat.reshape(batch_size, n)[rows, keep]
+            responses = responses.reshape(batch_size, n, -1)[rows, keep]
+
+            # ---- sparse filter (`grpo_r1_trainer.py:565-568`) -------------
+            nz = np.where(scores != 0)[0]
+            kept_frac = len(nz) / max(batch_size, 1)
+            if len(nz) == 0:
+                print(f"[sparse-grpo] update {update}: all advantages zero, skipping")
+                continue
+            scores, queries_f, responses_f = scores[nz], queries[nz], responses[nz]
+
+            # ---- de-pad (`:571-582`), menu-rounded ------------------------
+            q_pad = np.asarray(first_true_indices(jnp.asarray(queries_f) != pad_id))
+            ctx_needed = queries_f.shape[1] - int(q_pad.min())
+            context_length = round_up_to_menu(ctx_needed, self._len_menu)
+            context_length = min(context_length, queries_f.shape[1])
+            queries_f = queries_f[:, queries_f.shape[1] - context_length:]
+
+            post = np.asarray(truncate_response(eos_id, pad_id, jnp.asarray(responses_f)))
+            resp_len = np.asarray(first_true_indices(jnp.asarray(post) == pad_id))
+            max_resp = round_up_to_menu(
+                max(int(resp_len.max()), 1), self._len_menu
+            )
+            max_resp = min(max_resp, responses_f.shape[1])
+            responses_f = responses_f[:, :max_resp]
+            post = post[:, :max_resp]
+
+            qr = np.concatenate([queries_f, responses_f], axis=1)
+            qr_len = context_length + resp_len
+
+            # ---- bucketed logprob pass (budget 22·2316) -------------------
+            buckets = create_batches(qr_len, ROLLOUT_BUDGET)
+            logprobs = np.full(
+                (len(scores), max_resp), INVALID_LOGPROB, np.float32
+            )
+            ref_logprobs = logprobs.copy()
+            for idxs in buckets:
+                blen = round_up_to_menu(int(qr_len[idxs].max()), self._len_menu)
+                blen = min(max(blen, context_length + 1), qr.shape[1])
+                rows_b = round_up_to_menu(len(idxs), self._rows_menu)
+                padded = pad_rows(
+                    {"qr": qr[idxs][:, :blen]}, rows_b, {"qr": pad_id}
+                )
+                lp, rlp = score_fn(
+                    self.params, self.ref_params, jnp.asarray(padded["qr"]),
+                    context_length,
+                )
+                width = blen - context_length
+                logprobs[idxs, :width] = np.asarray(lp)[: len(idxs)]
+                ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
+
+            # ---- masks + advantages ---------------------------------------
+            seq_len = np.asarray(first_true_indices(jnp.asarray(post) == pad_id) - 1)
+            padding_mask, _ = response_padding_masks(post, jnp.asarray(seq_len))
+            padding_mask = np.asarray(padding_mask)
+            logprobs = np.where(padding_mask, INVALID_LOGPROB, logprobs)
+            ref_logprobs = np.where(padding_mask, INVALID_LOGPROB, ref_logprobs)
+            rewards = np.asarray(sparse_terminal_rewards(
+                jnp.asarray(scores), jnp.asarray(seq_len), max_resp
+            ))
+            advantages = np.asarray(discounted_returns(jnp.asarray(rewards), 1.0))
+            advantages = np.where(padding_mask, 0.0, advantages)
+
+            # ---- bucketed update (budget 4·2316, loss-scaled) -------------
+            trainable, frozen = self._partition(
+                self._train_tree(self.params, self.value_params)
+            )
+            all_stats = []
+            local_bs = len(scores)
+            mini = min(cfg.local_mini_batch_size, local_bs)
+            for epoch in range(cfg.num_ppo_epochs):
+                self.key, pk = jax.random.split(self.key)
+                perm = np.asarray(jax.random.permutation(pk, local_bs))
+                for start in range(0, local_bs, mini):
+                    mb_inds = perm[start : start + mini]
+                    mini_rows = len(mb_inds)
+                    grads_acc = None
+                    for bidx in create_batches(qr_len[mb_inds], BACKWARD_BUDGET):
+                        sel = mb_inds[bidx]
+                        blen = round_up_to_menu(int(qr_len[sel].max()), self._len_menu)
+                        blen = min(max(blen, context_length + 1), qr.shape[1])
+                        width = blen - context_length
+                        rows_b = round_up_to_menu(len(sel), self._rows_menu)
+                        mb = pad_rows(
+                            {
+                                "query_responses": qr[sel][:, :blen],
+                                "responses": responses_f[sel][:, :width],
+                                "logprobs": logprobs[sel][:, :width],
+                                "ref_logprobs": ref_logprobs[sel][:, :width],
+                                "advantages": advantages[sel][:, :width],
+                                "padding_mask": padding_mask[sel][:, :width],
+                            },
+                            rows_b,
+                            {"query_responses": pad_id, "responses": pad_id,
+                             "logprobs": INVALID_LOGPROB,
+                             "ref_logprobs": INVALID_LOGPROB,
+                             "padding_mask": True},
+                        )
+                        mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                        # scale by REAL rows (`grpo_r1_trainer.py:786-788`)
+                        loss_scale = len(sel) / mini_rows
+                        grads, aux = grad_fn(
+                            trainable, frozen, mb, context_length,
+                            jnp.float32(loss_scale),
+                        )
+                        grads_acc = grads if grads_acc is None else jax.tree.map(
+                            jnp.add, grads_acc, grads
+                        )
+                        all_stats.append(aux)
+                    trainable, self.opt_state = apply_fn(
+                        trainable, self.opt_state, grads_acc
+                    )
+            self.params = self._combine(trainable, frozen)["policy"]
+            all_stats = jax.device_get(all_stats)
+
+            # ---- metrics / eval / checkpoint ------------------------------
+            agg = {
+                k: float(np.mean([s[k] for s in all_stats]))
+                for k in (all_stats[0] if all_stats else {})
+            }
+            metrics = {
+                "objective/kl_old": agg.get("refkl_mean", 0.0),
+                "eval_objective/rlhf_reward_old": mean_raw_score,
+                "eval_objective/scores_old": mean_raw_score,
+                "policy/approxkl_avg_new": agg.get("approxkl", 0.0),
+                "policy/clipfrac_avg_new": agg.get("pg_clipfrac", 0.0),
+                "loss/policy_avg_new": agg.get("pg_loss", 0.0),
+                "val/ratio_new": agg.get("ratio_mean", 1.0),
+                "sparse/kept_frac": kept_frac,
+                "eval_response_length": log_responses_length,
+                "sec_per_episode": (time.time() - t_start) / cfg.batch_size,
+                "episode": self.state["episode"],
+            }
+            self.state["global_step"] += 1
+            if self.accuracy_func is not None and cfg.eval_steps and \
+                    self.state["global_step"] % cfg.eval_steps == 0:
+                metrics["eval_accuracy_new"] = float(self.accuracy_func(self))
+            if self.state["global_step"] % cfg.logging_steps == 0:
+                self.logger.log(self.state["global_step"], self.state["episode"], metrics)
+                kept_decoded = [decoded[i * n + j] for i, j in enumerate(keep)]
+                self.logger.log_samples(
+                    self.state["global_step"], question_strings, kept_decoded,
+                    raw_scores.reshape(batch_size, n)[rows, keep],
+                    cfg.num_printed_samples,
+                )
+            if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
+                self.ckpt.save(
+                    self.state["global_step"], self.params, rng_key=self.key,
+                    metric_old=metrics.get(cfg.metric_for_best_model),
+                )
+        return self.state

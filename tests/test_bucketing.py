@@ -1,0 +1,56 @@
+"""Bucket packing semantics + menu rounding."""
+
+import numpy as np
+
+from nanorlhf_tpu.trainer.bucketing import (
+    create_batches,
+    pad_rows,
+    round_up_to_menu,
+    shape_menu,
+)
+
+
+def test_create_batches_budget_respected():
+    lengths = np.array([10, 3, 7, 2, 9, 4])
+    budget = 18
+    batches = create_batches(lengths, budget)
+    # every index appears exactly once
+    flat = sorted(i for b in batches for i in b)
+    assert flat == list(range(6))
+    # budget model holds per bucket
+    for b in batches:
+        assert int(lengths[b].max()) * len(b) <= budget
+    # sorted ascending within the packing order
+    maxes = [int(lengths[b].max()) for b in batches]
+    assert maxes == sorted(maxes)
+
+
+def test_create_batches_single_overbudget_sample():
+    # one sample longer than the budget still gets its own bucket
+    batches = create_batches(np.array([100]), 18)
+    assert batches == [[0]]
+
+
+def test_create_batches_packs_greedily():
+    lengths = np.array([4, 4, 4, 4])
+    batches = create_batches(lengths, 16)
+    assert len(batches) == 1 and len(batches[0]) == 4
+
+
+def test_shape_menu_and_rounding():
+    menu = shape_menu(100, min_value=16)
+    assert menu == [16, 32, 64, 100]
+    assert round_up_to_menu(1, menu) == 16
+    assert round_up_to_menu(16, menu) == 16
+    assert round_up_to_menu(17, menu) == 32
+    assert round_up_to_menu(101, menu) == 100  # capped
+
+
+def test_pad_rows():
+    out = pad_rows(
+        {"a": np.ones((2, 3), np.int32), "m": np.zeros((2, 3), bool)},
+        4, {"a": 9, "m": True},
+    )
+    assert out["a"].shape == (4, 3)
+    np.testing.assert_array_equal(out["a"][2:], 9)
+    assert out["m"][2:].all()

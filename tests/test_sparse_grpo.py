@@ -1,0 +1,93 @@
+"""Sparse GRPO end-to-end on the CPU mesh: r1 reward protocol, sparse filter,
+bucketed logprob/update, accuracy eval hook."""
+
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer
+from nanorlhf_tpu.entrypoints.grpo_r1 import (
+    build_prompt_dataset,
+    make_accuracy_func,
+    make_r1_reward,
+    synthetic_math_corpus,
+)
+from nanorlhf_tpu.parallel import MeshConfig
+from nanorlhf_tpu.trainer import AlgoName, RLConfig
+from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
+
+
+def test_sparse_grpo_end_to_end(tmp_path):
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+
+    train_qa = synthetic_math_corpus(64)
+    eval_qa = synthetic_math_corpus(8, seed=1)
+    dataset = build_prompt_dataset(train_qa, tok, max_prompt_len=16)
+
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / "r1"),
+        response_length=8,
+        temperature=1.0,
+        sample_n=2,
+        kl_coef=0.0,
+        total_episodes=64,   # batch = 1*2*2*8 devices = 32 → 2 updates
+        per_device_train_batch_size=1,
+        gradient_accumulation_steps=2,
+        num_mini_batches=2,
+        learning_rate=1e-4,
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False,
+        mesh=MeshConfig(-1, 1, 1),
+        eval_steps=2,
+        save_steps=2,
+    )
+
+    # random model never answers correctly -> all-zero rewards -> z-scores 0
+    # -> everything sparse-filtered. Force variance with a random reward so
+    # the bucketed update path actually runs.
+    rng = np.random.default_rng(0)
+
+    def noisy_reward(pmt_and_responses, responses_ids, tokenizer):
+        return rng.random(len(pmt_and_responses)).astype(np.float32)
+
+    trainer = SparseGRPOTrainer(
+        cfg, mcfg, tok, params, dataset, noisy_reward,
+        accuracy_func=make_accuracy_func(eval_qa, max_prompt_len=16,
+                                         eval_response_length=4,
+                                         use_subprocess=False),
+    )
+    state = trainer.train()
+    assert state["global_step"] == 2
+
+    lines = [json.loads(l) for l in open(tmp_path / "r1" / "metrics.jsonl")]
+    assert "initial_accuracy" in lines[0]
+    step_lines = [l for l in lines if "sparse/kept_frac" in l]
+    assert step_lines and all(np.isfinite(l["loss/policy_avg_new"]) for l in step_lines)
+    assert any("eval_accuracy_new" in l for l in step_lines)
+
+
+def test_sparse_grpo_all_zero_rewards_skips_update(tmp_path):
+    """Binary reward that is always 0 -> every group filtered -> no crash."""
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    train_qa = synthetic_math_corpus(32)
+    dataset = build_prompt_dataset(train_qa, tok, max_prompt_len=16)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO, output_dir=str(tmp_path / "r0"), response_length=4,
+        temperature=1.0, sample_n=2, total_episodes=8,
+        per_device_train_batch_size=1, gradient_accumulation_steps=1,
+        num_mini_batches=1, use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False, mesh=MeshConfig(-1, 1, 1), save_steps=0,
+    )
+    reward = make_r1_reward(dict(train_qa), use_subprocess=False)
+    trainer = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, reward)
+    state = trainer.train()  # all updates skipped, but loop completes
+    assert state["episode"] == 8
