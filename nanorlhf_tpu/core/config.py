@@ -25,6 +25,9 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     max_position_embeddings: int = 32768
+    # "xla": einsum attention fused by XLA; "pallas": blockwise flash kernel
+    # (ops/attention.py) on full self-attention paths, XLA on decode steps
+    attention_impl: str = "xla"
 
     @property
     def actual_head_dim(self) -> int:

@@ -111,8 +111,22 @@ def gqa_attention(
     k: jnp.ndarray,       # [B, KV, Tk, hd]
     v: jnp.ndarray,       # [B, KV, Tk, hd]
     mask: jnp.ndarray,    # [B, 1, Tq, Tk] bool, True = attend
+    impl: str = "xla",
+    mask_is_causal_x_keyvalid: bool = False,
 ) -> jnp.ndarray:
+    """`mask_is_causal_x_keyvalid` asserts the mask factors as
+    causal(Tq,Tk) & key_valid[B,Tk] — required for the flash path, which
+    rebuilds the causal part in-kernel and keeps only the key-validity row.
+    Callers with arbitrary masks (prefix-LM etc.) must leave it False and get
+    the general XLA path."""
     B, H, Tq, hd = q.shape
+    Tk = k.shape[2]
+    if impl == "pallas" and mask_is_causal_x_keyvalid and Tq == Tk and Tq > 1:
+        # key-validity = the mask's last query row (causal there is all-True)
+        from nanorlhf_tpu.ops.attention import flash_attention
+
+        key_valid = mask[:, 0, -1, :]
+        return flash_attention(q, k, v, key_valid, causal=True)
     KV = k.shape[1]
     G = H // KV
     qg = q.reshape(B, KV, G, Tq, hd)
@@ -166,13 +180,19 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         k_cache, v_cache = kv_cache
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cache_index, 0))
-        attn_k, attn_v = k_cache, v_cache
         new_cache = (k_cache, v_cache)
+        if T > 1 and config.attention_impl == "pallas":
+            # prefill: cache slots beyond T are masked anyway, so attend over
+            # the local-length K/V through the flash kernel instead of the
+            # T_max-padded cache
+            out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
+                                mask_is_causal_x_keyvalid=True)
+        else:
+            out = gqa_attention(q, k_cache, v_cache, mask)
     else:
-        attn_k, attn_v = k, v
         new_cache = None
-
-    out = gqa_attention(q, attn_k, attn_v, mask)
+        out = gqa_attention(q, k, v, mask, impl=config.attention_impl,
+                            mask_is_causal_x_keyvalid=True)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _proj(out, layer_params, lora_layer, "o_proj", lora_scale)
     x = x + out

@@ -23,19 +23,23 @@ from nanorlhf_tpu.rewards.builders import make_torch_rm_reward
 from nanorlhf_tpu.trainer import RLConfig, RLTrainer
 
 
-def resolve_model(sft_model_path: str, seed: int = 0):
+def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "xla"):
     """(ModelConfig, params, tokenizer): HF checkpoint dir → load it; else an
     offline demo model (1.5B-shaped unless path says 'tiny')."""
+    import dataclasses
+
     if sft_model_path and os.path.isdir(sft_model_path):
         config, params = load_hf_checkpoint(sft_model_path)
         tokenizer = load_tokenizer(sft_model_path)
-        return config, params, tokenizer
-    print(f"[offline demo] '{sft_model_path}' not found locally — "
-          "random-init model + toy tokenizer")
-    tiny = "tiny" in (sft_model_path or "")
-    config = ModelConfig.qwen2_tiny(vocab_size=4096) if tiny else ModelConfig.qwen2_1_5b()
-    tokenizer = ToyTokenizer(vocab_size=min(4096, config.vocab_size))
-    params = init_params(config, jax.random.PRNGKey(seed), jnp.bfloat16)
+    else:
+        print(f"[offline demo] '{sft_model_path}' not found locally — "
+              "random-init model + toy tokenizer")
+        tiny = "tiny" in (sft_model_path or "")
+        config = ModelConfig.qwen2_tiny(vocab_size=4096) if tiny else ModelConfig.qwen2_1_5b()
+        tokenizer = ToyTokenizer(vocab_size=min(4096, config.vocab_size))
+        params = init_params(config, jax.random.PRNGKey(seed), jnp.bfloat16)
+    if attention_impl != config.attention_impl:
+        config = dataclasses.replace(config, attention_impl=attention_impl)
     return config, params, tokenizer
 
 
@@ -75,7 +79,9 @@ def run(cfg: RLConfig, value_params_fn=None, post_build=None):
     freshly resolved policy (PPO). `post_build(trainer, dataset, reward_func)`
     runs before training (PPO's value-initializer phase).
     """
-    mcfg, params, tokenizer = resolve_model(cfg.sft_model_path, cfg.seed)
+    mcfg, params, tokenizer = resolve_model(
+        cfg.sft_model_path, cfg.seed, attention_impl=cfg.attention_impl
+    )
     dataset = resolve_dataset(cfg, tokenizer)
     reward_func = resolve_rm_reward(cfg.reward_model_path)
     value_params = value_params_fn(mcfg, params) if value_params_fn else None

@@ -1,4 +1,6 @@
 from nanorlhf_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules, shard_params, batch_sharding
+from nanorlhf_tpu.parallel.ring_attention import ring_attention
+from nanorlhf_tpu.parallel.distributed import initialize_multihost, broadcast_host_value
 
 __all__ = [
     "MeshConfig",
@@ -6,4 +8,7 @@ __all__ = [
     "param_sharding_rules",
     "shard_params",
     "batch_sharding",
+    "ring_attention",
+    "initialize_multihost",
+    "broadcast_host_value",
 ]

@@ -1,0 +1,83 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+The reference has no sequence/context parallelism at all — it reaches 8k
+tokens on one GPU purely by shape economy (SURVEY.md §2.3, §5.7). For a TPU
+pod, long context is a first-class axis: the sequence dim is sharded over a
+mesh axis and K/V shards rotate around the ring via `lax.ppermute` (one hop
+per step, riding ICI), while each device keeps its Q shard and folds every
+incoming K/V block into an online-softmax accumulator. Communication
+overlaps compute; memory per device is O(T/n · T/n) per block instead of
+O(T²).
+
+`ring_attention` runs *inside* `shard_map` over the sequence axis. Causal
+structure across shards follows global positions: a K/V chunk entirely in
+the future contributes nothing (masked), the diagonal chunk applies the
+in-chunk causal mask, past chunks attend fully.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,           # [B, H, T_local, d]   (this device's Q shard)
+    k: jnp.ndarray,           # [B, KV, T_local, d]  (this device's K shard)
+    v: jnp.ndarray,           # [B, KV, T_local, d]
+    key_valid: jnp.ndarray,   # [B, T_local] bool    (this device's mask shard)
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact attention over the full (sharded) sequence. Returns [B,H,T_local,d]."""
+    my_idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    B, H, T, d = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    qg = q.astype(jnp.float32).reshape(B, KV, G, T, d)
+
+    local_pos = jnp.arange(T)
+    q_pos = my_idx * T + local_pos                       # global q positions
+
+    # derive the accumulators from qg so they carry the same varying-axis
+    # type as the rotated K/V (shard_map check_vma compatibility)
+    m0 = jnp.zeros_like(qg[..., :1]) + NEG_INF
+    l0 = jnp.zeros_like(qg[..., :1])
+    acc0 = jnp.zeros_like(qg)
+
+    def step(s, carry):
+        m, l, acc, k_cur, v_cur, valid_cur = carry
+        src = (my_idx - s) % n                           # owner of current K/V
+        k_pos = src * T + local_pos                      # global k positions
+
+        scores = jnp.einsum(
+            "bkgqd,bktd->bkgqt", qg, k_cur.astype(jnp.float32)
+        ) * scale                                        # [B,KV,G,T,T]
+        mask = valid_cur[:, None, None, None, :]
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])[None, None, None, :, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bkgqt,bktd->bkgqd", p, v_cur.astype(jnp.float32)
+        )
+
+        # rotate K/V/mask one hop around the ring (device i -> i+1)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        valid_nxt = jax.lax.ppermute(valid_cur, axis_name, perm)
+        return m_new, l_new, acc_new, k_nxt, v_nxt, valid_nxt
+
+    m, l, acc, *_ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v, key_valid))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(B, H, T, d).astype(q.dtype)

@@ -84,8 +84,9 @@ class RLConfig:
     lora_r: int = 64
     lora_alpha: int = 16
 
-    # ---- memory ----
+    # ---- memory / kernels ----
     gradient_checkpointing: bool = True
+    attention_impl: str = "xla"   # "pallas" = flash kernel on full-seq paths
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1

@@ -158,6 +158,9 @@ class RLTrainer:
             config.output_dir, config.save_total_limit, config.greater_is_better
         )
         self.logger = MetricsLogger(config.output_dir, config.report_to)
+        from nanorlhf_tpu.utils.profiling import PhaseTimer
+
+        self.timer = PhaseTimer()
         self._update_fn = self._make_update_fn()
         self.state = {"episode": 0, "global_step": 0}
 
@@ -401,20 +404,24 @@ class RLTrainer:
 
             # ---- ROLLOUT -------------------------------------------------
             self.key, gen_key = jax.random.split(self.key)
-            responses = generate(
-                self.params, self.mcfg, queries_j, prompt_mask, gen_key,
-                sampling, eos_token_id=eos_id, pad_token_id=pad_id,
-                lora_scale=self.lora_scale,
-            )                                               # [B*n, T]
+            with self.timer.phase("rollout"):
+                responses = generate(
+                    self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                    sampling, eos_token_id=eos_id, pad_token_id=pad_id,
+                    lora_scale=self.lora_scale,
+                )                                           # [B*n, T]
+                responses.block_until_ready()
             greedy_responses = None
             if self.algo == AlgoName.REMAX:
                 # extra greedy rollout as baseline (`ReMax/remax_trainer.py:166-185`)
-                greedy_responses = generate(
-                    self.params, self.mcfg, queries_j, prompt_mask, gen_key,
-                    SamplingParams(greedy=True, max_tokens=cfg.response_length),
-                    eos_token_id=eos_id, pad_token_id=pad_id,
-                    lora_scale=self.lora_scale,
-                )
+                with self.timer.phase("rollout"):
+                    greedy_responses = generate(
+                        self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                        SamplingParams(greedy=True, max_tokens=cfg.response_length),
+                        eos_token_id=eos_id, pad_token_id=pad_id,
+                        lora_scale=self.lora_scale,
+                    )
+                    greedy_responses.block_until_ready()
 
             # ---- REWARD (host-side, user callable) -------------------------
             question_strings = [
@@ -423,13 +430,14 @@ class RLTrainer:
             question_n = [q for q in question_strings for _ in range(n)]
             responses_np = np.asarray(responses)
             responses_decoded = tok.batch_decode(responses_np)
-            scores = np.asarray(
-                self.reward_func(
-                    [q + r for q, r in zip(question_n, responses_decoded)],
-                    tok.eos_token,
-                ),
-                dtype=np.float32,
-            )
+            with self.timer.phase("reward"):
+                scores = np.asarray(
+                    self.reward_func(
+                        [q + r for q, r in zip(question_n, responses_decoded)],
+                        tok.eos_token,
+                    ),
+                    dtype=np.float32,
+                )
             log_scores_all = scores.copy()  # raw sampled-rollout scores for logging
             if greedy_responses is not None:
                 greedy_decoded = tok.batch_decode(np.asarray(greedy_responses))
@@ -472,13 +480,14 @@ class RLTrainer:
             )
             chunk = pick_chunk_size(total, chunk)
             logprobs_l, ref_logprobs_l = [], []
-            for i in range(0, total, chunk):
-                lp, rlp = score_fn(
-                    self.params, self.ref_params,
-                    jnp.asarray(qr[i : i + chunk]), context_length,
-                )
-                logprobs_l.append(np.asarray(lp))
-                ref_logprobs_l.append(np.asarray(rlp))
+            with self.timer.phase("logprob"):
+                for i in range(0, total, chunk):
+                    lp, rlp = score_fn(
+                        self.params, self.ref_params,
+                        jnp.asarray(qr[i : i + chunk]), context_length,
+                    )
+                    logprobs_l.append(np.asarray(lp))
+                    ref_logprobs_l.append(np.asarray(rlp))
             logprobs = np.concatenate(logprobs_l)
             ref_logprobs = np.concatenate(ref_logprobs_l)
 
@@ -524,28 +533,29 @@ class RLTrainer:
             all_stats = []
             local_bs = batch["responses"].shape[0]
             mini = max(1, local_bs // cfg.num_mini_batches)
-            for epoch in range(cfg.num_ppo_epochs):
-                self.key, pk = jax.random.split(self.key)
-                perm = np.asarray(jax.random.permutation(pk, local_bs))
-                for start in range(0, local_bs - mini + 1, mini):
-                    inds = perm[start : start + mini]
-                    mb = {
-                        k: jax.device_put(
-                            jnp.asarray(v[inds]),
-                            batch_sharding(self.mesh, np.asarray(v).ndim),
+            with self.timer.phase("update"):
+                for epoch in range(cfg.num_ppo_epochs):
+                    self.key, pk = jax.random.split(self.key)
+                    perm = np.asarray(jax.random.permutation(pk, local_bs))
+                    for start in range(0, local_bs - mini + 1, mini):
+                        inds = perm[start : start + mini]
+                        mb = {
+                            k: jax.device_put(
+                                jnp.asarray(v[inds]),
+                                batch_sharding(self.mesh, np.asarray(v).ndim),
+                            )
+                            for k, v in batch.items()
+                        }
+                        trainable, self.opt_state, stats = self._update_fn(
+                            trainable, frozen, self.opt_state, mb, context_length
                         )
-                        for k, v in batch.items()
-                    }
-                    trainable, self.opt_state, stats = self._update_fn(
-                        trainable, frozen, self.opt_state, mb, context_length
-                    )
-                    # keep stats on device; syncing per minibatch would
-                    # serialize update dispatch
-                    all_stats.append(stats)
-            train_tree = self._combine(trainable, frozen)
-            self.params = train_tree["policy"]
-            self.value_params = train_tree.get("value")
-            all_stats = jax.device_get(all_stats)
+                        # keep stats on device; syncing per minibatch would
+                        # serialize update dispatch
+                        all_stats.append(stats)
+                train_tree = self._combine(trainable, frozen)
+                self.params = train_tree["policy"]
+                self.value_params = train_tree.get("value")
+                all_stats = jax.device_get(all_stats)
 
             # ---- METRICS ---------------------------------------------------
             sec_per_episode = (time.time() - t_start) / cfg.batch_size
@@ -578,6 +588,7 @@ class RLTrainer:
             if "vf_loss" in agg:
                 metrics["loss/value_avg_new"] = agg["vf_loss"]
                 metrics["val/clipfrac_avg_new"] = agg.get("vf_clipfrac", 0.0)
+            metrics.update(self.timer.summary())
             self.state["global_step"] += 1
             if self.state["global_step"] % cfg.logging_steps == 0:
                 self.logger.log(self.state["global_step"], self.state["episode"], metrics)

@@ -1,0 +1,3 @@
+from nanorlhf_tpu.utils.profiling import PhaseTimer, trace_profile
+
+__all__ = ["PhaseTimer", "trace_profile"]

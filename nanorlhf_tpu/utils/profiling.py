@@ -1,0 +1,59 @@
+"""Tracing / profiling utilities (SURVEY.md §5.1).
+
+The reference's observability is ad-hoc: an unused memory_profiler import, a
+commented-out CUDA memory recorder, and one wall-clock print per update
+(`/root/reference/GRPO/grpo_trainer.py:57,469,726`). The TPU-native
+equivalents:
+
+- `PhaseTimer`: per-phase wall-clock split (rollout / reward / logprob /
+  update) the reference only has implicitly — `block_until_ready` at phase
+  end so device async dispatch doesn't lie about where the time goes;
+- `trace_profile`: a `jax.profiler` trace context writing a TensorBoard-
+  loadable profile (XLA op breakdown, HBM usage) to a directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class PhaseTimer:
+    """Accumulates wall-clock per named phase; one line per update."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Callers must block on the phase's outputs inside the block (e.g.
+        `jax.block_until_ready(...)`) or async dispatch shifts time into the
+        next phase."""
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.totals[name] = self.totals.get(name, 0.0) + (time.time() - t0)
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self, reset: bool = True) -> dict:
+        out = {f"time/{k}_s": v for k, v in self.totals.items()}
+        if reset:
+            self.totals, self.counts = {}, {}
+        return out
+
+
+@contextlib.contextmanager
+def trace_profile(log_dir: str, enabled: bool = True):
+    """jax.profiler trace scope: `with trace_profile('/tmp/prof'): step()`."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

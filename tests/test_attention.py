@@ -1,0 +1,86 @@
+"""Pallas flash attention (interpret mode on CPU) vs the XLA reference."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.ops.attention import flash_attention, reference_attention
+
+
+def make_qkv(rng, B=2, H=4, KV=2, T=24, d=16):
+    q = rng.normal(size=(B, H, T, d)).astype(np.float32)
+    k = rng.normal(size=(B, KV, T, d)).astype(np.float32)
+    v = rng.normal(size=(B, KV, T, d)).astype(np.float32)
+    valid = np.ones((B, T), bool)
+    valid[0, :5] = False   # left-padding pattern
+    valid[1, :2] = False
+    return map(jnp.asarray, (q, k, v, valid))
+
+
+def test_flash_matches_reference_causal(rng):
+    q, k, v, valid = make_qkv(rng)
+    got = flash_attention(q, k, v, valid, causal=True, block_q=8, block_k=8)
+    want = reference_attention(q, k, v, valid, causal=True)
+    # compare only valid query rows (padding rows are unconstrained)
+    mask = np.asarray(valid)[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * mask, np.asarray(want) * mask, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_matches_reference_non_causal(rng):
+    q, k, v, valid = make_qkv(rng, T=16)
+    got = flash_attention(q, k, v, valid, causal=False, block_q=8, block_k=8)
+    want = reference_attention(q, k, v, valid, causal=False)
+    mask = np.asarray(valid)[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * mask, np.asarray(want) * mask, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_non_multiple_length(rng):
+    q, k, v, valid = make_qkv(rng, T=13)
+    got = flash_attention(q, k, v, valid, causal=True, block_q=8, block_k=8)
+    want = reference_attention(q, k, v, valid, causal=True)
+    mask = np.asarray(valid)[:, None, :, None]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(
+        np.asarray(got) * mask, np.asarray(want) * mask, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_gqa_groups(rng):
+    # H=8 query heads over KV=2 shared heads exercises the h//G index map
+    q, k, v, valid = make_qkv(rng, H=8, KV=2, T=16)
+    got = flash_attention(q, k, v, valid, causal=True, block_q=8, block_k=8)
+    want = reference_attention(q, k, v, valid, causal=True)
+    mask = np.asarray(valid)[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(got) * mask, np.asarray(want) * mask, rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_gradients_match_reference(rng):
+    q, k, v, valid = make_qkv(rng, T=16)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, valid, causal=True, block_q=8, block_k=8)
+        return jnp.sum(out * jnp.where(valid[:, None, :, None], 1.0, 0.0))
+
+    def loss_ref(q, k, v):
+        out = reference_attention(q, k, v, valid, causal=True)
+        return jnp.sum(out * jnp.where(valid[:, None, :, None], 1.0, 0.0))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_flash_fully_masked_batch_row_is_finite(rng):
+    q, k, v, valid = make_qkv(rng, T=16)
+    valid = valid.at[0, :].set(False)  # entire row masked
+    out = flash_attention(q, k, v, valid, causal=True, block_q=8, block_k=8)
+    assert bool(jnp.all(jnp.isfinite(out)))
