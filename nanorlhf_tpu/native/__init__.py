@@ -1,0 +1,118 @@
+"""Native (C++) host-runtime components, loaded via ctypes.
+
+Compiled on first import with the baked-in g++ (no pip installs available;
+pybind11 absent — a plain C ABI + ctypes keeps the binding surface zero-
+dependency). Every entry point has a pure-Python fallback, so the framework
+degrades gracefully on hosts without a toolchain; tests pin native ==
+Python semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _build_and_load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    src = os.path.join(os.path.dirname(__file__), "bucketing.cpp")
+    cache_dir = os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "nanorlhf_tpu",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, "libnanorlhf_native.so")
+    try:
+        if (not os.path.exists(so_path)
+                or os.path.getmtime(so_path) < os.path.getmtime(src)):
+            # pid-unique tmp: concurrent processes (pytest workers, multi-host
+            # launchers sharing $HOME) must not clobber each other mid-write
+            tmp_path = f"{so_path}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o",
+                 tmp_path],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp_path, so_path)
+        lib = ctypes.CDLL(so_path)
+        lib.create_batches.restype = ctypes.c_int
+        lib.create_batches.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        for fn in (lib.pack_left_pad, lib.pack_right_pad):
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int, ctypes.c_int, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+        _LIB = lib
+    except Exception as e:  # missing toolchain etc. → Python fallback
+        print(f"[native] build/load failed ({type(e).__name__}), "
+              "using Python fallbacks")
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _build_and_load() is not None
+
+
+def create_batches_native(lengths, budget: int):
+    """Native bucket packing; returns list[list[int]] (or None w/o lib)."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    lengths = np.ascontiguousarray(np.asarray(lengths, np.int64))
+    n = len(lengths)
+    out_indices = np.empty(n, np.int32)
+    out_offsets = np.empty(n + 1, np.int32)
+    n_buckets = lib.create_batches(
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, int(budget),
+        out_indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        out_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+    )
+    return [
+        out_indices[out_offsets[b]:out_offsets[b + 1]].tolist()
+        for b in range(n_buckets)
+    ]
+
+
+def _pack(rows, max_len: int, pad_id: int, left: bool):
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    lens = np.asarray([len(r) for r in rows], np.int64)
+    flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(r, np.int32) for r in rows])
+        if len(rows) else np.empty(0, np.int32)
+    )
+    out = np.empty((len(rows), max_len), np.int32)
+    fn = lib.pack_left_pad if left else lib.pack_right_pad
+    fn(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(rows), max_len, pad_id,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
+
+
+def pack_left_pad_native(rows, max_len: int, pad_id: int):
+    return _pack(rows, max_len, pad_id, left=True)
+
+
+def pack_right_pad_native(rows, max_len: int, pad_id: int):
+    return _pack(rows, max_len, pad_id, left=False)

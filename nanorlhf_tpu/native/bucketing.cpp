@@ -1,0 +1,91 @@
+// Host-side data-path kernels: bucket packing + batch padding.
+//
+// The reference's data path leans on native code it doesn't own (Rust HF
+// tokenizers, vLLM's C++ scheduler — SURVEY.md §2.2). This library is the
+// framework's own native runtime piece: the per-update host work that sits
+// between tokenization and device transfer, where Python loops become the
+// bottleneck at large batch×length (the r1 trainer re-packs every minibatch,
+// `/root/reference/examples/r1-v0/grpo_r1_trainer.py:700-788`).
+//
+// Exposed via a C ABI, loaded with ctypes (no pybind11 in this image).
+// Semantics are pinned by tests against the Python implementations.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Greedy length-sorted packing under max(cur_len, len) * (count+1) <= budget.
+// lengths: n int64s. out_indices: n ints (bucket-grouped sample indices).
+// out_offsets: (n+1) ints (bucket b = out_indices[out_offsets[b]..out_offsets[b+1]]).
+// Returns the number of buckets.
+int create_batches(const int64_t* lengths, int n, int64_t budget,
+                   int* out_indices, int* out_offsets) {
+  std::vector<int> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int a, int b) { return lengths[a] < lengths[b]; });
+
+  int n_buckets = 0;
+  int out_pos = 0;
+  int64_t cur_len = 0;
+  int cur_count = 0;
+  out_offsets[0] = 0;
+  for (int oi = 0; oi < n; ++oi) {
+    int idx = order[oi];
+    int64_t sample_len = lengths[idx];
+    int64_t future = std::max(cur_len, sample_len) * (cur_count + 1);
+    if (future > budget && cur_count > 0) {
+      out_offsets[++n_buckets] = out_pos;
+      cur_len = 0;
+      cur_count = 0;
+    }
+    out_indices[out_pos++] = idx;
+    cur_len = std::max(cur_len, sample_len);
+    cur_count += 1;
+  }
+  if (cur_count > 0) {
+    out_offsets[++n_buckets] = out_pos;
+  }
+  return n_buckets;
+}
+
+// Left-pad ragged token rows into a [n, max_len] int32 matrix.
+// tokens_flat: concatenated rows; lens: per-row lengths (each <= max_len
+// after caller-side truncation; rows longer than max_len keep their TAIL).
+void pack_left_pad(const int32_t* tokens_flat, const int64_t* lens, int n,
+                   int max_len, int32_t pad_id, int32_t* out) {
+  int64_t offset = 0;
+  for (int i = 0; i < n; ++i) {
+    int64_t len = lens[i];
+    const int32_t* row = tokens_flat + offset;
+    offset += len;
+    if (len > max_len) {  // keep tail
+      row += len - max_len;
+      len = max_len;
+    }
+    int32_t* dst = out + (int64_t)i * max_len;
+    std::fill(dst, dst + (max_len - len), pad_id);
+    std::memcpy(dst + (max_len - len), row, len * sizeof(int32_t));
+  }
+}
+
+// Right-pad variant (RM scoring batches, response tensors).
+void pack_right_pad(const int32_t* tokens_flat, const int64_t* lens, int n,
+                    int max_len, int32_t pad_id, int32_t* out) {
+  int64_t offset = 0;
+  for (int i = 0; i < n; ++i) {
+    int64_t len = lens[i];
+    const int32_t* row = tokens_flat + offset;
+    offset += len;
+    if (len > max_len) len = max_len;  // keep head
+    int32_t* dst = out + (int64_t)i * max_len;
+    std::memcpy(dst, row, len * sizeof(int32_t));
+    std::fill(dst + len, dst + max_len, pad_id);
+  }
+}
+
+}  // extern "C"

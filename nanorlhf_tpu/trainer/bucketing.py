@@ -21,7 +21,14 @@ def create_batches(lengths, max_batch_memory_size: int) -> list[list[int]]:
 
     Exact packing semantics of `_create_batches`
     (`grpo_r1_trainer.py:410-435`); returns index lists into `lengths`.
+    Dispatches to the C++ implementation (native/bucketing.cpp) when the
+    library is available; tests pin both paths identical.
     """
+    from nanorlhf_tpu import native
+
+    out = native.create_batches_native(lengths, max_batch_memory_size)
+    if out is not None:
+        return out
     lengths = np.asarray(lengths)
     order = np.argsort(lengths, kind="stable")
     batches: list[list[int]] = []

@@ -30,12 +30,32 @@ class CheckpointManager:
         os.makedirs(self.output_dir, exist_ok=True)
         self._ckpt_dirs: list[str] = self._existing()
         # metric history: step -> metric measured ON that step's saved policy
-        # (arrives one save later under the `_old` convention)
+        # (arrives one save later under the `_old` convention). Persisted to
+        # disk so best-checkpoint protection and load-best survive a resume.
         self._metric_by_step: dict[int, float] = {}
         self._last_saved_step: int | None = None
+        self._load_metric_history()
         import orbax.checkpoint as ocp
 
         self._ckptr = ocp.PyTreeCheckpointer()
+
+    @property
+    def _history_path(self) -> str:
+        return os.path.join(self.output_dir, "best_metric_history.json")
+
+    def _load_metric_history(self):
+        if os.path.exists(self._history_path):
+            with open(self._history_path) as f:
+                data = json.load(f)
+            self._metric_by_step = {int(k): v for k, v in data.get("metrics", {}).items()}
+            self._last_saved_step = data.get("last_saved_step")
+
+    def _save_metric_history(self):
+        with open(self._history_path, "w") as f:
+            json.dump(
+                {"metrics": self._metric_by_step,
+                 "last_saved_step": self._last_saved_step}, f,
+            )
 
     def _existing(self) -> list[str]:
         if not os.path.isdir(self.output_dir):
@@ -63,12 +83,21 @@ class CheckpointManager:
         self._ckptr.save(os.path.join(path, "tree"), tree)
         state = {"step": step}
         if rng_key is not None:
-            state["rng_key"] = np.asarray(jax.random.key_data(rng_key)).tolist()
+            import jax.numpy as jnp
+
+            typed = jnp.issubdtype(rng_key.dtype, jax.dtypes.prng_key)
+            state["rng_key"] = np.asarray(
+                jax.random.key_data(rng_key) if typed else rng_key
+            ).tolist()
+            state["rng_key_typed"] = bool(typed)
         state.update(extra_state or {})
         with open(os.path.join(path, "trainer_state.json"), "w") as f:
             json.dump(state, f)
+        if path in self._ckpt_dirs:  # re-saving a step after resume
+            self._ckpt_dirs.remove(path)
         self._ckpt_dirs.append(path)
         self._last_saved_step = step
+        self._save_metric_history()
         self._rotate()
         return path
 

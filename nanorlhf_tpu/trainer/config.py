@@ -91,6 +91,7 @@ class RLConfig:
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1
     save_total_limit: int = 8
+    save_optimizer_state: bool = True   # opt state + PRNG for exact resume
     metric_for_best_model: str = "eval_objective/rlhf_reward_old"
     greater_is_better: bool = True
     load_best_model_at_end: bool = True

@@ -189,7 +189,10 @@ class SparseGRPOTrainer(RLTrainer):
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length,
         )
-        n_updates = cfg.num_total_batches if num_updates is None else num_updates
+        n_updates = (
+            max(0, cfg.num_total_batches - self.state["global_step"])
+            if num_updates is None else num_updates
+        )
 
         for update in range(1, n_updates + 1):
             t_start = time.time()
@@ -370,7 +373,10 @@ class SparseGRPOTrainer(RLTrainer):
                 )
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
                 self.ckpt.save(
-                    self.state["global_step"], self.params, rng_key=self.key,
+                    self.state["global_step"], self.params,
+                    opt_state=self.opt_state if cfg.save_optimizer_state else None,
+                    rng_key=self.key,
                     metric_old=metrics.get(cfg.metric_for_best_model),
+                    extra_state={"episode": self.state["episode"]},
                 )
         return self.state

@@ -391,7 +391,12 @@ class RLTrainer:
             max_tokens=cfg.response_length,
         )
 
-        n_updates = cfg.num_total_batches if num_updates is None else num_updates
+        # after a resume, the default budget is the REMAINING updates, not a
+        # fresh full run
+        n_updates = (
+            max(0, cfg.num_total_batches - self.state["global_step"])
+            if num_updates is None else num_updates
+        )
         for update in range(1, n_updates + 1):
             t_start = time.time()
             self.state["episode"] += cfg.batch_size
@@ -601,9 +606,11 @@ class RLTrainer:
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
                 self.ckpt.save(
                     self.state["global_step"], self.params,
+                    opt_state=self.opt_state if cfg.save_optimizer_state else None,
                     rng_key=self.key,
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
+                    extra_state={"episode": self.state["episode"]},
                 )
 
         # load_best_model_at_end parity (`GRPO/grpo.py:149`, resolved via the
@@ -611,9 +618,37 @@ class RLTrainer:
         if cfg.load_best_model_at_end and num_updates is None:
             best = self.ckpt.best_step()
             if best is not None and best != self.state["global_step"]:
-                restored = self.ckpt.restore(best, {"params": self.params})
-                self.params = restored["params"]
+                like = {"params": self.params}
+                if cfg.save_optimizer_state:
+                    like["opt_state"] = self.opt_state
+                self.params = self.ckpt.restore(best, like)["params"]
                 print(f"loaded best checkpoint (step {best})")
+        return self.state
+
+    def resume_from_checkpoint(self, step: Optional[int] = None):
+        """Restore params (+ optimizer state, PRNG key, step/episode counters)
+        from a saved checkpoint. `step=None` → latest.
+
+        The reference persists optimizer/scheduler/RNG every save
+        (`grpo_trainer.py:345-349`) but ships no resume entry point
+        (SURVEY.md §5.3); this is that entry point.
+        """
+        step = step if step is not None else self.ckpt.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.cfg.output_dir}")
+        like = {"params": self.params}
+        if self.cfg.save_optimizer_state:
+            like["opt_state"] = self.opt_state
+        restored = self.ckpt.restore(step, like)
+        self.params = restored["params"]
+        if "opt_state" in restored:
+            self.opt_state = restored["opt_state"]
+        tstate = self.ckpt.load_trainer_state(step)
+        self.state["global_step"] = tstate["step"]
+        self.state["episode"] = tstate.get("episode", 0)
+        if "rng_key" in tstate:
+            raw = jnp.asarray(np.asarray(tstate["rng_key"], dtype=np.uint32))
+            self.key = jax.random.wrap_key_data(raw) if tstate.get("rng_key_typed") else raw
         return self.state
 
     def close(self):

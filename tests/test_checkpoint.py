@@ -44,6 +44,24 @@ def test_save_total_limit_one_keeps_newest(tmp_path):
     assert cm.best_step() == 1 and 1 in steps
 
 
+def test_metric_history_survives_new_manager(tmp_path):
+    """Best-checkpoint knowledge must survive a process restart (resume)."""
+    out = str(tmp_path / "ckh")
+    cm = CheckpointManager(out, save_total_limit=2, greater_is_better=True)
+    cm.save(1, params_like(1))
+    cm.save(2, params_like(2), metric_old=9.0)    # best = step 1
+    # simulated restart
+    cm2 = CheckpointManager(out, save_total_limit=2, greater_is_better=True)
+    assert cm2.best_step() == 1
+    cm2.save(3, params_like(3), metric_old=1.0)
+    cm2.save(4, params_like(4), metric_old=2.0)
+    steps = _steps(out)
+    assert 1 in steps, "pre-restart best must stay rotation-protected"
+    # re-saving an existing step must not duplicate bookkeeping
+    cm2.save(4, params_like(44))
+    assert sorted(set(_steps(out))) == _steps(out)
+
+
 def test_restore_roundtrip(tmp_path):
     out = str(tmp_path / "ck2")
     cm = CheckpointManager(out, save_total_limit=3)

@@ -1,0 +1,76 @@
+"""Native C++ data-path kernels == Python reference semantics."""
+
+import numpy as np
+import pytest
+
+from nanorlhf_tpu import native
+
+
+def python_create_batches(lengths, budget):
+    lengths = np.asarray(lengths)
+    order = np.argsort(lengths, kind="stable")
+    batches, current, cur_len = [], [], 0
+    for idx in order:
+        sample_len = int(lengths[idx])
+        if max(cur_len, sample_len) * (len(current) + 1) > budget and current:
+            batches.append(current)
+            current, cur_len = [], 0
+        current.append(int(idx))
+        cur_len = max(cur_len, sample_len)
+    if current:
+        batches.append(current)
+    return batches
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+def test_native_builds(lib_available):
+    assert native.available()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_create_batches_matches_python(lib_available, seed):
+    rng = np.random.default_rng(seed)
+    lengths = rng.integers(1, 200, size=64)
+    for budget in (64, 512, 4096):
+        got = native.create_batches_native(lengths, budget)
+        want = python_create_batches(lengths, budget)
+        assert got == want
+
+
+def test_create_batches_single(lib_available):
+    assert native.create_batches_native([1000], 10) == [[0]]
+
+
+def test_pack_left_pad(lib_available, rng):
+    rows = [list(rng.integers(1, 100, size=n)) for n in (3, 7, 0, 5)]
+    got = native.pack_left_pad_native(rows, 7, 0)
+    want = np.zeros((4, 7), np.int32)
+    for i, r in enumerate(rows):
+        r = r[-7:]
+        if r:
+            want[i, 7 - len(r):] = r
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pack_left_pad_truncates_head(lib_available):
+    # rows longer than max_len keep their TAIL (prompt semantics)
+    got = native.pack_left_pad_native([[1, 2, 3, 4, 5]], 3, 0)
+    np.testing.assert_array_equal(got, [[3, 4, 5]])
+
+
+def test_pack_right_pad(lib_available, rng):
+    rows = [[1, 2, 3], [4], []]
+    got = native.pack_right_pad_native(rows, 4, 9)
+    np.testing.assert_array_equal(got, [[1, 2, 3, 9], [4, 9, 9, 9], [9, 9, 9, 9]])
+
+
+def test_bucketing_module_dispatches_to_native(lib_available):
+    from nanorlhf_tpu.trainer.bucketing import create_batches
+
+    lengths = [5, 1, 9, 2, 2, 7]
+    assert create_batches(lengths, 12) == python_create_batches(lengths, 12)
