@@ -38,12 +38,9 @@ class PromptDataset:
 
 
 def _left_pad(seqs: list[list[int]], pad_id: int, max_len: int | None = None) -> np.ndarray:
+    # numpy is already optimal here (per-row assignment); the native pack
+    # kernels exist for callers that hold pre-flattened token buffers
     max_len = max_len or max(len(s) for s in seqs)
-    from nanorlhf_tpu import native
-
-    packed = native.pack_left_pad_native(seqs, max_len, pad_id)
-    if packed is not None:
-        return packed
     out = np.full((len(seqs), max_len), pad_id, np.int32)
     for i, s in enumerate(seqs):
         s = s[-max_len:]

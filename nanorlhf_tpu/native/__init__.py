@@ -59,7 +59,11 @@ def _build_and_load():
             ]
         _LIB = lib
     except Exception as e:  # missing toolchain etc. → Python fallback
-        print(f"[native] build/load failed ({type(e).__name__}), "
+        detail = ""
+        stderr = getattr(e, "stderr", None)
+        if stderr:
+            detail = ": " + stderr.decode(errors="replace")[-500:]
+        print(f"[native] build/load failed ({type(e).__name__}{detail}), "
               "using Python fallbacks")
         _LIB = None
     return _LIB

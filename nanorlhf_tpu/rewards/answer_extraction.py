@@ -1,0 +1,63 @@
+"""Benchmark-style answer extraction from model completions.
+
+Capability parity with the vendored Qwen data-processing toolkit
+(`/root/reference/examples/r1-v0/utils/data_processing/
+answer_extraction.py:245-330`): per-format extractors that recover a final
+answer string from free-form reasoning text. Compact fresh implementation
+covering the formats the training/eval paths use.
+"""
+
+from __future__ import annotations
+
+import re
+
+from nanorlhf_tpu.rewards.math_grader import get_boxed
+
+_ANSWER_MARKERS = (
+    "the answer is:",
+    "the answer is",
+    "the final answer is",
+    "final answer:",
+    "answer:",
+)
+
+_NUMBER_RE = re.compile(r"-?\d[\d,]*(?:\.\d+)?(?:/\d+)?")
+
+
+def extract_after_marker(text: str) -> str:
+    """Text after the last 'The answer is'-style marker (MetaMathQA format,
+    `grpo_r1.py:231-234`)."""
+    low = text.lower()
+    best = -1
+    best_len = 0
+    for marker in _ANSWER_MARKERS:
+        i = low.rfind(marker)
+        if i > best:
+            best, best_len = i, len(marker)
+    if best == -1:
+        return ""
+    ans = text[best + best_len:].strip()
+    # stop at sentence/line end
+    for stop in ("\n", ". ", ".\n"):
+        j = ans.find(stop)
+        if j != -1:
+            ans = ans[:j]
+    return ans.strip().rstrip(".")
+
+
+def extract_last_number(text: str) -> str:
+    """Last number in the text (GSM8K-style fallback)."""
+    matches = _NUMBER_RE.findall(text)
+    return matches[-1].replace(",", "") if matches else ""
+
+
+def extract_answer(text: str, fmt: str = "auto") -> str:
+    """Dispatcher: 'boxed' | 'marker' | 'last_number' | 'auto'
+    (boxed → marker → last number)."""
+    if fmt == "boxed":
+        return get_boxed(text)
+    if fmt == "marker":
+        return extract_after_marker(text)
+    if fmt == "last_number":
+        return extract_last_number(text)
+    return get_boxed(text) or extract_after_marker(text) or extract_last_number(text)

@@ -1,0 +1,72 @@
+"""Sandboxed execution of model-emitted Python — program-of-thought grading.
+
+Capability parity with the vendored Qwen eval toolkit's `PythonExecutor`
+(`/root/reference/examples/r1-v0/utils/eval/python_executor.py:42`): run a
+code snippet in a killable subprocess with a wall-clock timeout, capture the
+value of an `answer` variable (or stdout), never let model code touch the
+training process. Host-side only.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import sys
+import traceback
+from dataclasses import dataclass
+from io import StringIO
+
+
+@dataclass
+class ExecutionResult:
+    ok: bool
+    answer: str = ""
+    stdout: str = ""
+    error: str = ""
+
+
+def _exec_worker(code: str, answer_expr: str | None, q):
+    buf = StringIO()
+    old_stdout = sys.stdout
+    sys.stdout = buf
+    try:
+        glb: dict = {"__name__": "__main__"}
+        exec(code, glb)  # noqa: S102 — sandboxed by subprocess + timeout
+        answer = ""
+        if answer_expr:
+            try:
+                answer = repr(eval(answer_expr, glb))  # noqa: S307
+            except Exception:
+                answer = ""
+        elif "answer" in glb:
+            answer = repr(glb["answer"])
+        q.put(("ok", answer, buf.getvalue()))
+    except Exception:
+        q.put(("err", "", buf.getvalue() + "\n" + traceback.format_exc()))
+    finally:
+        sys.stdout = old_stdout
+
+
+class PythonExecutor:
+    """`run(code)` → ExecutionResult; `timeout` seconds per snippet."""
+
+    def __init__(self, timeout: float = 5.0, answer_expr: str | None = None):
+        self.timeout = timeout
+        self.answer_expr = answer_expr
+
+    def run(self, code: str) -> ExecutionResult:
+        ctx = multiprocessing.get_context("fork")
+        q = ctx.Queue()
+        p = ctx.Process(target=_exec_worker, args=(code, self.answer_expr, q))
+        p.start()
+        p.join(self.timeout)
+        if p.is_alive():
+            p.terminate()
+            p.join()
+            return ExecutionResult(ok=False, error=f"timeout after {self.timeout}s")
+        try:
+            status, answer, stdout = q.get(timeout=0.5)
+        except Exception:
+            return ExecutionResult(ok=False, error="no result (crashed?)")
+        if status == "ok":
+            return ExecutionResult(ok=True, answer=answer, stdout=stdout)
+        return ExecutionResult(ok=False, stdout=stdout, error=stdout)

@@ -69,9 +69,11 @@ class CheckpointManager:
         )
 
     def save(self, step: int, params, opt_state=None, rng_key=None,
-             metric_old: float | None = None, extra_state: dict | None = None):
+             metric_old: float | None = None, extra_state: dict | None = None,
+             value_params=None):
         """Save a checkpoint. `metric_old`, when given, scores the *previous*
-        checkpoint (the `_old` semantics) and is recorded against it."""
+        checkpoint (the `_old` semantics) and is recorded against it.
+        `value_params` adds the PPO value model (`PPO/ppo_trainer.py:413-416`)."""
         if metric_old is not None and self._last_saved_step is not None:
             self._metric_by_step[self._last_saved_step] = float(metric_old)
 
@@ -80,6 +82,8 @@ class CheckpointManager:
         tree = {"params": params}
         if opt_state is not None:
             tree["opt_state"] = opt_state
+        if value_params is not None:
+            tree["value"] = value_params
         self._ckptr.save(os.path.join(path, "tree"), tree)
         state = {"step": step}
         if rng_key is not None:
@@ -134,6 +138,20 @@ class CheckpointManager:
 
         restored = self._ckptr.restore(path, item=like)
         return restored
+
+    def truncate_after(self, step: int):
+        """Drop checkpoints and metric history newer than `step` — called on
+        resume-from-an-earlier-step so the abandoned trajectory's saves can't
+        hijack latest_step()/best_step() or misattribute the next metric_old."""
+        for d in list(self._ckpt_dirs):
+            if int(d.rsplit("-", 1)[1]) > step:
+                shutil.rmtree(d, ignore_errors=True)
+                self._ckpt_dirs.remove(d)
+        self._metric_by_step = {
+            k: v for k, v in self._metric_by_step.items() if k <= step
+        }
+        self._last_saved_step = step
+        self._save_metric_history()
 
     def load_trainer_state(self, step: int) -> dict:
         with open(

@@ -92,6 +92,8 @@ class RLConfig:
     save_steps: int = 1
     save_total_limit: int = 8
     save_optimizer_state: bool = True   # opt state + PRNG for exact resume
+    save_value_model: bool = True       # PPO: value model in the checkpoint
+                                        # (`PPO/ppo_trainer.py:413-416`)
     metric_for_best_model: str = "eval_objective/rlhf_reward_old"
     greater_is_better: bool = True
     load_best_model_at_end: bool = True

@@ -16,13 +16,27 @@ import time
 
 
 class MetricsLogger:
+    """Sinks: "jsonl" (default), "tensorboard" (jsonl + TB event files via
+    torch's SummaryWriter — the reference's value-init reports to tensorboard,
+    `PPO/ppo.py:100`), "none". wandb (`GRPO/grpo.py:136`) needs egress; point
+    any dashboard at the JSONL instead."""
+
     def __init__(self, output_dir: str, report_to: str = "jsonl"):
         self.output_dir = output_dir
         self.report_to = report_to
         self._fh = None
-        if report_to == "jsonl":
+        self._tb = None
+        if report_to in ("jsonl", "tensorboard"):
             os.makedirs(output_dir, exist_ok=True)
             self._fh = open(os.path.join(output_dir, "metrics.jsonl"), "a")
+        if report_to == "tensorboard":
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(os.path.join(output_dir, "tb"))
+            except Exception as e:
+                print(f"[metrics] tensorboard unavailable ({type(e).__name__}); "
+                      "jsonl only")
 
     def log(self, step: int, episode: int, metrics: dict):
         record = {"step": step, "episode": episode, "time": time.time()}
@@ -34,6 +48,9 @@ class MetricsLogger:
         if self._fh:
             self._fh.write(line + "\n")
             self._fh.flush()
+        if self._tb:
+            for k, v in metrics.items():
+                self._tb.add_scalar(k, float(v), step)
 
     def log_samples(self, step: int, queries: list[str], responses: list[str],
                     scores, limit: int = 5):
@@ -55,3 +72,5 @@ class MetricsLogger:
     def close(self):
         if self._fh:
             self._fh.close()
+        if self._tb:
+            self._tb.close()

@@ -130,11 +130,15 @@ class RLTrainer:
 
         # ref policy = frozen copy of the base weights (the reference loads
         # the same SFT model twice, `GRPO/grpo.py:218-224`); sharded alike.
+        # Copy-on-intake: device_put with an unchanged sharding ALIASES the
+        # caller's buffers, and the jitted update donates its inputs — without
+        # the copy, training would invalidate the arrays the caller passed in.
         ref = {k: v for k, v in params.items() if k != "lora"}
         self.ref_params = shard_params(jax.tree.map(jnp.copy, ref), self.mesh)
-        self.params = shard_params(params, self.mesh)
+        self.params = shard_params(jax.tree.map(jnp.copy, params), self.mesh)
         self.value_params = (
-            shard_params(value_params, self.mesh) if value_params is not None else None
+            shard_params(jax.tree.map(jnp.copy, value_params), self.mesh)
+            if value_params is not None else None
         )
         if self.algo == AlgoName.PPO and self.value_params is None:
             raise ValueError("PPO requires value_params")
@@ -611,6 +615,7 @@ class RLTrainer:
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
                     extra_state={"episode": self.state["episode"]},
+                    value_params=self.value_params if cfg.save_value_model else None,
                 )
 
         # load_best_model_at_end parity (`GRPO/grpo.py:149`, resolved via the
@@ -618,12 +623,19 @@ class RLTrainer:
         if cfg.load_best_model_at_end and num_updates is None:
             best = self.ckpt.best_step()
             if best is not None and best != self.state["global_step"]:
-                like = {"params": self.params}
-                if cfg.save_optimizer_state:
-                    like["opt_state"] = self.opt_state
-                self.params = self.ckpt.restore(best, like)["params"]
+                self.params = self.ckpt.restore(best, self._restore_template())["params"]
                 print(f"loaded best checkpoint (step {best})")
         return self.state
+
+    def _restore_template(self):
+        """Mirror of what checkpoint.save() writes — single source of truth
+        for restore structure."""
+        like = {"params": self.params}
+        if self.cfg.save_optimizer_state:
+            like["opt_state"] = self.opt_state
+        if self.cfg.save_value_model and self.value_params is not None:
+            like["value"] = self.value_params
+        return like
 
     def resume_from_checkpoint(self, step: Optional[int] = None):
         """Restore params (+ optimizer state, PRNG key, step/episode counters)
@@ -633,16 +645,19 @@ class RLTrainer:
         (`grpo_trainer.py:345-349`) but ships no resume entry point
         (SURVEY.md §5.3); this is that entry point.
         """
-        step = step if step is not None else self.ckpt.latest_step()
+        latest = self.ckpt.latest_step()
+        step = step if step is not None else latest
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.cfg.output_dir}")
-        like = {"params": self.params}
-        if self.cfg.save_optimizer_state:
-            like["opt_state"] = self.opt_state
-        restored = self.ckpt.restore(step, like)
+        restored = self.ckpt.restore(step, self._restore_template())
+        if latest is not None and step < latest:
+            # resuming an earlier step abandons the newer trajectory
+            self.ckpt.truncate_after(step)
         self.params = restored["params"]
         if "opt_state" in restored:
             self.opt_state = restored["opt_state"]
+        if "value" in restored:
+            self.value_params = restored["value"]
         tstate = self.ckpt.load_trainer_state(step)
         self.state["global_step"] = tstate["step"]
         self.state["episode"] = tstate.get("episode", 0)

@@ -1,0 +1,61 @@
+"""Python executor sandbox + answer extraction dispatcher."""
+
+import pytest
+
+from nanorlhf_tpu.rewards.answer_extraction import (
+    extract_after_marker,
+    extract_answer,
+    extract_last_number,
+)
+from nanorlhf_tpu.rewards.python_executor import PythonExecutor
+
+
+class TestExecutor:
+    def test_answer_variable(self):
+        r = PythonExecutor(timeout=3).run("x = 6\nanswer = x * 7")
+        assert r.ok and r.answer == "42"
+
+    def test_stdout_captured(self):
+        r = PythonExecutor(timeout=3).run("print('hello')\nanswer = 1")
+        assert r.ok and "hello" in r.stdout
+
+    def test_answer_expr(self):
+        r = PythonExecutor(timeout=3, answer_expr="y + 1").run("y = 9")
+        assert r.ok and r.answer == "10"
+
+    def test_error_reported(self):
+        r = PythonExecutor(timeout=3).run("1/0")
+        assert not r.ok and "ZeroDivisionError" in r.error
+
+    def test_infinite_loop_times_out(self):
+        r = PythonExecutor(timeout=0.5).run("while True: pass")
+        assert not r.ok and "timeout" in r.error
+
+    def test_model_code_cannot_kill_parent(self):
+        r = PythonExecutor(timeout=2).run("import os; os._exit(3)")
+        assert not r.ok  # child died; parent unaffected (we're still here)
+
+
+class TestExtraction:
+    def test_marker(self):
+        assert extract_after_marker("blah blah The answer is: 42") == "42"
+        assert extract_after_marker("So the final answer is 7.") == "7"
+        assert extract_after_marker("no marker here") == ""
+
+    def test_marker_stops_at_sentence(self):
+        assert extract_after_marker("The answer is 5. And more text") == "5"
+
+    def test_last_number(self):
+        assert extract_last_number("first 3 then 4,000 end") == "4000"
+        assert extract_last_number("none") == ""
+
+    @pytest.mark.parametrize(
+        "text,want",
+        [
+            (r"reasoning \boxed{9}", "9"),                   # boxed wins
+            ("The answer is: 13", "13"),                     # marker next
+            ("it is about 7 or maybe 8", "8"),               # last number
+        ],
+    )
+    def test_auto_dispatch(self, text, want):
+        assert extract_answer(text) == want
