@@ -123,11 +123,18 @@ def logprobs_from_logits(
 
     Temperature divides the logits *before* log-softmax, exactly as in the
     reference logprob pass (`/root/reference/GRPO/grpo_trainer.py:547-549`).
-    Computed in float32 for stability regardless of input dtype.
+
+    Memory-shaped for big vocabularies: computed as
+    `logit[label]/T − logsumexp(logits/T)` so no [B, T, V] log-softmax (or
+    f32 copy of the logits) is ever materialized — the f32 convert fuses
+    into the logsumexp reduction. At Qwen2's 152k vocab this halves the
+    peak HBM of the scoring/update passes. f32 math throughout.
     """
-    logits = logits.astype(jnp.float32) / temperature
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    return jnp.take_along_axis(logps, labels[..., None], axis=-1)[..., 0]
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32) / temperature, axis=-1
+    )
+    return label_logits.astype(jnp.float32) / temperature - lse
 
 
 def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:

@@ -53,7 +53,7 @@ from nanorlhf_tpu.trainer.bucketing import (
     round_up_to_menu,
     shape_menu,
 )
-from nanorlhf_tpu.trainer.trainer import RLTrainer
+from nanorlhf_tpu.trainer.trainer import RLTrainer, forward_token_budget
 
 ROLLOUT_BUDGET = 22 * 2316   # forward memory model (`grpo_r1_trainer.py:589`)
 BACKWARD_BUDGET = 4 * 2316   # backward memory model (`grpo_r1_trainer.py:700`)
@@ -256,8 +256,15 @@ class SparseGRPOTrainer(RLTrainer):
             qr = np.concatenate([queries_f, responses_f], axis=1)
             qr_len = context_length + resp_len
 
-            # ---- bucketed logprob pass (budget 22·2316) -------------------
-            buckets = create_batches(qr_len, ROLLOUT_BUDGET)
+            # ---- bucketed logprob pass (budget 22·2316, capped so the
+            # [tokens, vocab] logits block fits HBM) ------------------------
+            rollout_budget = min(
+                ROLLOUT_BUDGET, forward_token_budget(self.mcfg.vocab_size)
+            )
+            backward_budget = min(
+                BACKWARD_BUDGET, forward_token_budget(self.mcfg.vocab_size) // 2
+            )
+            buckets = create_batches(qr_len, rollout_budget)
             logprobs = np.full(
                 (len(scores), max_resp), INVALID_LOGPROB, np.float32
             )
@@ -303,7 +310,7 @@ class SparseGRPOTrainer(RLTrainer):
                     mb_inds = perm[start : start + mini]
                     mini_rows = len(mb_inds)
                     grads_acc = None
-                    for bidx in create_batches(qr_len[mb_inds], BACKWARD_BUDGET):
+                    for bidx in create_batches(qr_len[mb_inds], backward_budget):
                         sel = mb_inds[bidx]
                         blen = round_up_to_menu(int(qr_len[sel].max()), self._len_menu)
                         blen = min(max(blen, context_length + 1), qr.shape[1])

@@ -69,10 +69,16 @@ from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
 from nanorlhf_tpu.trainer.config import AlgoName, RLConfig
 from nanorlhf_tpu.trainer.metrics import MetricsLogger
 
-# rollout-phase forward chunking: empirical per-token memory budget, the
-# TPU analogue of the reference's `22*2316//(ctx+resp)` formula
-# (`GRPO/grpo_trainer.py:534`). Tunable via cfg.local_rollout_forward_batch_size.
-_FORWARD_TOKEN_BUDGET = 22 * 2316
+# rollout-phase forward chunking — the TPU analogue of the reference's
+# `22*2316//(ctx+resp)` memory formula (`GRPO/grpo_trainer.py:534`), but
+# derived from what actually bounds the pass: the [tokens, vocab] logits
+# block. Budget the chunk so logits stay under ~2 GB bf16 per forward.
+# Tunable via cfg.local_rollout_forward_batch_size.
+_LOGITS_BYTES_BUDGET = 2 * 1024**3
+
+
+def forward_token_budget(vocab_size: int, bytes_per_elem: int = 2) -> int:
+    return max(1024, _LOGITS_BYTES_BUDGET // (vocab_size * bytes_per_elem))
 
 
 def pick_chunk_size(total: int, desired: int) -> int:
@@ -485,7 +491,9 @@ class RLTrainer:
             qr = np.concatenate([queries_rep, responses_np], axis=1)
             total = qr.shape[0]
             chunk = cfg.local_rollout_forward_batch_size or max(
-                1, _FORWARD_TOKEN_BUDGET // (context_length + cfg.response_length)
+                1,
+                forward_token_budget(self.mcfg.vocab_size)
+                // (context_length + cfg.response_length),
             )
             chunk = pick_chunk_size(total, chunk)
             logprobs_l, ref_logprobs_l = [], []
@@ -769,7 +777,9 @@ class RLTrainer:
     def _value_pass(self, qr, context_length):
         """Chunked value prediction (`PPO/ppo_trainer.py:630-634`)."""
         total = qr.shape[0]
-        chunk = pick_chunk_size(total, max(1, _FORWARD_TOKEN_BUDGET // qr.shape[1]))
+        # value forward emits [B, T, 1] scores — no vocab-sized logits block —
+        # so the activation-based token budget applies, not the vocab cap
+        chunk = pick_chunk_size(total, max(1, (22 * 2316) // qr.shape[1]))
         vals = []
         if not hasattr(self, "_value_fn"):
             from functools import partial
