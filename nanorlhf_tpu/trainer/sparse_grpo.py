@@ -53,10 +53,14 @@ from nanorlhf_tpu.trainer.bucketing import (
     round_up_to_menu,
     shape_menu,
 )
-from nanorlhf_tpu.trainer.trainer import RLTrainer, forward_token_budget
+from nanorlhf_tpu.trainer.trainer import (
+    ACTIVATION_TOKEN_BUDGET,
+    RLTrainer,
+    forward_token_budget,
+)
 
-ROLLOUT_BUDGET = 22 * 2316   # forward memory model (`grpo_r1_trainer.py:589`)
-BACKWARD_BUDGET = 4 * 2316   # backward memory model (`grpo_r1_trainer.py:700`)
+ROLLOUT_BUDGET = ACTIVATION_TOKEN_BUDGET   # forward model (`grpo_r1_trainer.py:589`)
+BACKWARD_BUDGET = 4 * 2316                 # backward model (`grpo_r1_trainer.py:700`)
 
 
 class SparseGRPOTrainer(RLTrainer):

@@ -69,16 +69,19 @@ from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
 from nanorlhf_tpu.trainer.config import AlgoName, RLConfig
 from nanorlhf_tpu.trainer.metrics import MetricsLogger
 
-# rollout-phase forward chunking — the TPU analogue of the reference's
-# `22*2316//(ctx+resp)` memory formula (`GRPO/grpo_trainer.py:534`), but
-# derived from what actually bounds the pass: the [tokens, vocab] logits
-# block. Budget the chunk so logits stay under ~2 GB bf16 per forward.
+# Rollout-phase forward chunking. Two independent memory models bound the
+# chunk: (1) the reference's empirical activation budget `22*2316` tokens
+# (`GRPO/grpo_trainer.py:534`), (2) the [tokens, vocab] logits block, capped
+# at ~2 GB bf16 (dominant at LLM-sized vocabularies — the fixed constant
+# alone would OOM a 16 GB chip at 152k vocab). Chunks take the min of both.
 # Tunable via cfg.local_rollout_forward_batch_size.
+ACTIVATION_TOKEN_BUDGET = 22 * 2316
 _LOGITS_BYTES_BUDGET = 2 * 1024**3
 
 
 def forward_token_budget(vocab_size: int, bytes_per_elem: int = 2) -> int:
-    return max(1024, _LOGITS_BYTES_BUDGET // (vocab_size * bytes_per_elem))
+    vocab_cap = max(1024, _LOGITS_BYTES_BUDGET // (vocab_size * bytes_per_elem))
+    return min(ACTIVATION_TOKEN_BUDGET, vocab_cap)
 
 
 def pick_chunk_size(total: int, desired: int) -> int:
@@ -778,8 +781,10 @@ class RLTrainer:
         """Chunked value prediction (`PPO/ppo_trainer.py:630-634`)."""
         total = qr.shape[0]
         # value forward emits [B, T, 1] scores — no vocab-sized logits block —
-        # so the activation-based token budget applies, not the vocab cap
-        chunk = pick_chunk_size(total, max(1, (22 * 2316) // qr.shape[1]))
+        # so only the activation-based token budget applies
+        chunk = pick_chunk_size(
+            total, max(1, ACTIVATION_TOKEN_BUDGET // qr.shape[1])
+        )
         vals = []
         if not hasattr(self, "_value_fn"):
             from functools import partial

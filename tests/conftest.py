@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Belt and suspenders: site plugins (e.g. a tunneled-TPU registrar in
+# sitecustomize) may have already overridden jax_platforms via jax.config at
+# interpreter startup — config beats env vars, so force it back here too.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402
