@@ -53,14 +53,11 @@ from nanorlhf_tpu.trainer.bucketing import (
     round_up_to_menu,
     shape_menu,
 )
-from nanorlhf_tpu.trainer.trainer import (
-    ACTIVATION_TOKEN_BUDGET,
-    RLTrainer,
-    forward_token_budget,
-)
+from nanorlhf_tpu.trainer.trainer import RLTrainer, forward_token_budget
 
-ROLLOUT_BUDGET = ACTIVATION_TOKEN_BUDGET   # forward model (`grpo_r1_trainer.py:589`)
-BACKWARD_BUDGET = 4 * 2316                 # backward model (`grpo_r1_trainer.py:700`)
+# forward budget comes from forward_token_budget (activation ∧ vocab caps);
+# backward keeps the reference's dedicated constant (`grpo_r1_trainer.py:700`)
+BACKWARD_BUDGET = 4 * 2316
 
 
 class SparseGRPOTrainer(RLTrainer):
@@ -262,9 +259,7 @@ class SparseGRPOTrainer(RLTrainer):
 
             # ---- bucketed logprob pass (budget 22·2316, capped so the
             # [tokens, vocab] logits block fits HBM) ------------------------
-            rollout_budget = min(
-                ROLLOUT_BUDGET, forward_token_budget(self.mcfg.vocab_size)
-            )
+            rollout_budget = forward_token_budget(self.mcfg.vocab_size)
             backward_budget = min(
                 BACKWARD_BUDGET, forward_token_budget(self.mcfg.vocab_size) // 2
             )

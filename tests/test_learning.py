@@ -1,0 +1,77 @@
+"""The RL loop actually optimizes: reward must improve on a learnable task.
+
+The reference's de-facto validation is a rising reward curve (SURVEY.md §4);
+this is the miniature, deterministic version: a tiny model + a reward that
+prefers emitting EOS early is learnable within a few updates, so mean reward
+over the last updates must beat the first update's.
+"""
+
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+from nanorlhf_tpu.parallel import MeshConfig
+from nanorlhf_tpu.trainer import RLConfig, AlgoName, RLTrainer
+
+
+def test_grpo_reward_improves(tmp_path):
+    tok = ToyTokenizer(128)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / "learn"),
+        response_length=8,
+        temperature=1.0,
+        sample_n=4,
+        kl_coef=0.0,                 # pure reward maximization
+        total_episodes=12 * 16,      # 12 updates × batch 16
+        per_device_train_batch_size=1,
+        gradient_accumulation_steps=1,
+        num_mini_batches=2,
+        num_ppo_epochs=1,
+        learning_rate=5e-2,          # aggressive: tiny fp32 model, 24 steps
+        logging_steps=1,
+        num_printed_samples=0,
+        use_lora=False,              # full fine-tune for fastest movement
+        gradient_checkpointing=False,
+        mesh=MeshConfig(-1, 1, 1),
+        save_steps=0,
+        load_best_model_at_end=False,
+    )
+    dataset = load_prompt_dataset("synthetic:64", tok, max_prompt_len=10)
+
+    def reward(pmt_and_responses, eos_token):
+        # dense, trivially learnable: reward repetition — the fraction of the
+        # response taken by its most frequent token. Every sample carries
+        # signal, so the group baseline gets real variance from update 1.
+        out = []
+        for s in pmt_and_responses:
+            resp = s.split("<assistant>")[-1]
+            words = resp.split()
+            if not words:
+                out.append(0.0)
+                continue
+            _, top = max(((w, words.count(w)) for w in set(words)), key=lambda kv: kv[1])
+            out.append(top / len(words))
+        return np.asarray(out, np.float32)
+
+    trainer = RLTrainer(cfg, mcfg, tok, params, dataset, reward)
+    trainer.train()
+
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "learn" / "metrics.jsonl")
+        if "samples" not in l
+    ]
+    rewards = [l["eval_objective/rlhf_reward_old"] for l in lines]
+    assert len(rewards) == 12
+    early = float(np.mean(rewards[:2]))
+    late = float(np.mean(rewards[-3:]))
+    # observed trajectory: ~0.17 → ~0.75; the bar leaves wide seed margin
+    assert late > early + 0.2, f"no learning: first2={early:.3f}, last3={late:.3f}, all={rewards}"
