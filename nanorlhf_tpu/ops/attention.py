@@ -12,11 +12,14 @@ grpo.py:219,223` — CUDA, SURVEY.md §2.2). Design:
   heads re-read the same KV block instead of materializing repeats.
 - **Causal skip**: kv blocks entirely above the diagonal skip their compute
   under `pl.when` (half the FLOPs at long T).
-- **Backward**: `jax.custom_vjp` whose bwd re-runs the XLA reference
-  attention under `jax.vjp` — same cost/memory as the pre-kernel training
-  path, so the kernel can be adopted on the no-grad-heavy paths (rollout
-  prefill, logprob scoring) with zero risk to training numerics. A fused
-  Pallas backward is the next optimization.
+- **Backward**: fused Pallas kernels (FlashAttention-2 style). The forward
+  emits per-row LSE as a residual; `_dq_kernel` accumulates dQ over kv
+  blocks, `_dkv_kernel` accumulates dK/dV over (group, q-block) — the GQA
+  group sum happens in-scratch, so gradients come out already reduced to
+  [B, KV, T, d]. No [T, T] probability matrix is ever materialized in either
+  direction. `NANORLHF_FLASH_BWD=xla` switches the backward to an XLA
+  reference recompute for hardware triage (values validated; anything else
+  than pallas/xla raises).
 
 Padding contract matches the model's mask recipe: `key_valid` is the [B, T]
 attention mask; query rows that are padding produce garbage rows which the
@@ -80,7 +83,8 @@ def reference_attention(q, k, v, key_valid, causal: bool = True):
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, acc_ref, m_ref, l_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, lse_ref,
+                  acc_ref, m_ref, l_ref,
                   *, scale: float, block_q: int, block_k: int, causal: bool):
     kv_idx = pl.program_id(3)
     q_idx = pl.program_id(2)
@@ -139,6 +143,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)            # fully-masked rows → 0/1
         out_ref[0, 0] = (acc_ref[:] / l).astype(out_ref.dtype)
+        # lse = m + log(l): the backward residual (P = exp(S − lse))
+        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
 
 
 def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
@@ -168,9 +174,16 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j),
                          memory_space=_VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
-                               memory_space=_VMEM),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+                         memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -181,26 +194,247 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
 
 
 # ---------------------------------------------------------------------------
+# Pallas backward kernels (FlashAttention-2 style)
+#
+# With P = exp(S − lse), D_i = Σ_j dO_ij · O_ij:
+#   dV = Pᵀ @ dO        dP = dO @ Vᵀ        dS = P ⊙ (dP − D)
+#   dQ = dS @ K · scale          dK = dSᵀ @ Q · scale
+# Two kernels: dq iterates kv blocks per q block; dk/dv iterate q blocks per
+# kv block (emitted per query head, summed over GQA groups outside).
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+               dq_out_ref, dq_acc_ref,
+               *, scale: float, block_q: int, block_k: int, causal: bool):
+    kv_idx = pl.program_id(3)
+    q_idx = pl.program_id(2)
+    n_kv = pl.num_programs(3)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    q_start = q_idx * block_q
+    kv_start = kv_idx * block_k
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]                     # [Bq, 1]
+        delta = delta_ref[0, 0][:, None]                 # [Bq, 1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        key_ok = mask_ref[0] > 0
+        s = jnp.where(key_ok[None, :], s, NEG_INF)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc_ref[:] = dq_acc_ref[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    if causal:
+        pl.when(kv_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finalize():
+        dq_out_ref[0, 0] = dq_acc_ref[:].astype(dq_out_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+                dk_out_ref, dv_out_ref, dk_acc_ref, dv_acc_ref,
+                *, scale: float, block_q: int, block_k: int, causal: bool):
+    # grid (B, KV, n_kv, G, n_q): q blocks fastest, then the GQA group — the
+    # group sum accumulates in scratch, emitting dk/dv already [B, KV, T, d]
+    q_idx = pl.program_id(4)
+    g_idx = pl.program_id(3)
+    kv_idx = pl.program_id(2)
+    n_q = pl.num_programs(4)
+    n_g = pl.num_programs(3)
+
+    @pl.when((q_idx == 0) & (g_idx == 0))
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    q_start = q_idx * block_q
+    kv_start = kv_idx * block_k
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        key_ok = mask_ref[0] > 0
+        s = jnp.where(key_ok[None, :], s, NEG_INF)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        # dV += Pᵀ @ dO
+        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dK += dSᵀ @ Q · scale
+        dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+
+    if causal:
+        pl.when(kv_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when((q_idx == n_q - 1) & (g_idx == n_g - 1))
+    def _finalize():
+        dk_out_ref[0, 0] = dk_acc_ref[:].astype(dk_out_ref.dtype)
+        dv_out_ref[0, 0] = dv_acc_ref[:].astype(dv_out_ref.dtype)
+
+
+def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
+                    interpret):
+    B, H, T, d = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    scale = 1.0 / (d ** 0.5)
+    n_q = pl.cdiv(T, block_q)
+    n_kv = pl.cdiv(T, block_k)
+    mask_i32 = key_valid.astype(jnp.int32)
+    # D_i = Σ_j dO·O — cheap elementwise+reduce, left to XLA fusion
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    common_q_specs = dict(
+        q=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                       memory_space=_VMEM),
+        k=pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h // G, j, 0),
+                       memory_space=_VMEM),
+        v=pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h // G, j, 0),
+                       memory_space=_VMEM),
+        mask=pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j),
+                          memory_space=_VMEM),
+        do=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
+                        memory_space=_VMEM),
+        lse=pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+                         memory_space=_VMEM),
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(B, H, n_q, n_kv),
+        in_specs=[common_q_specs["q"], common_q_specs["k"], common_q_specs["v"],
+                  common_q_specs["mask"], common_q_specs["do"],
+                  common_q_specs["lse"], common_q_specs["lse"]],
+        out_specs=common_q_specs["q"],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, mask_i32, g, lse, delta)
+
+    # dk/dv: kv head and block outer; (group, q block) inner with q fastest.
+    # Scratch accumulates across BOTH inner axes, so the GQA group sum happens
+    # in-kernel and the outputs are already reduced to [B, KV, T, d] — no
+    # G x-sized per-query-head gradient buffers in HBM.
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, causal=causal),
+        grid=(B, KV, n_kv, G, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, kv, j, gq, i: (b, kv, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b, kv, j, gq, i: (b, kv, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, block_k), lambda b, kv, j, gq, i: (b, j),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i),
+                         memory_space=_VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, kv, j, gq, i: (b, kv, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, kv, j, gq, i: (b, kv, j, 0),
+                         memory_space=_VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, mask_i32, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
 # public entry: custom_vjp + shape handling
 # ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash_attention_core(q, k, v, key_valid, causal, block_q, block_k):
-    return _flash_forward(q, k, v, key_valid, causal, block_q, block_k,
-                          interpret=_interpret_default())
+    out, _ = _flash_forward(q, k, v, key_valid, causal, block_q, block_k,
+                            interpret=_interpret_default())
+    return out
 
 
 def _core_fwd(q, k, v, key_valid, causal, block_q, block_k):
-    out = _flash_attention_core(q, k, v, key_valid, causal, block_q, block_k)
-    return out, (q, k, v, key_valid)
+    out, lse = _flash_forward(q, k, v, key_valid, causal, block_q, block_k,
+                              interpret=_interpret_default())
+    return out, (q, k, v, key_valid, out, lse)
 
 
 def _core_bwd(causal, block_q, block_k, residuals, g):
-    q, k, v, key_valid = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(q_, k_, v_, key_valid, causal),
-                     q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, key_valid, out, lse = residuals
+    bwd_impl = os.environ.get("NANORLHF_FLASH_BWD", "pallas")
+    if bwd_impl not in ("pallas", "xla"):
+        raise ValueError(
+            f"NANORLHF_FLASH_BWD={bwd_impl!r}: must be 'pallas' or 'xla'"
+        )
+    if bwd_impl == "xla":
+        # triage escape hatch: recompute through the XLA reference
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention(q_, k_, v_, key_valid, causal),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+    else:
+        dq, dk, dv = _flash_backward(
+            q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
+            interpret=_interpret_default(),
+        )
     return dq, dk, dv, None
 
 
