@@ -155,11 +155,14 @@ def _proj(h, layer_params, lora_layer, name, lora_scale):
 
 
 def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
-                cache_index, lora_layer=None, lora_scale=1.0):
+                cache_index, lora_layer=None, lora_scale=1.0, attn_fn=None):
     """One decoder layer. If kv_cache is not None, operate incrementally.
 
     Returns (x_out, new_kv_pair_or_None).
     kv_cache: (k_cache, v_cache) each [B, KV, T_max, hd] or None.
+    `attn_fn(q, k, v)`, when given, replaces the attention contraction (used
+    by the sequence-parallel path to route through ring attention) — every
+    other op stays this single implementation.
     """
     hd = config.actual_head_dim
     H, KV = config.num_attention_heads, config.num_key_value_heads
@@ -176,7 +179,10 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if kv_cache is not None:
+    if attn_fn is not None:
+        new_cache = None
+        out = attn_fn(q, k, v)
+    elif kv_cache is not None:
         k_cache, v_cache = kv_cache
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cache_index, 0))

@@ -1,0 +1,96 @@
+"""Sequence-parallel model forward: the whole decoder under shard_map.
+
+Long-context as a first-class axis (the reference has none — SURVEY.md §5.7):
+the sequence dimension is sharded over a mesh axis; attention runs as ring
+attention (K/V rotating over ICI, `parallel/ring_attention.py`) while RMSNorm,
+RoPE, projections and the MLP are position-local and need no communication.
+Per-device memory for activations and attention state scales with T/n instead
+of T, so contexts beyond a single device's HBM become trainable/scoreable.
+
+Caveats (v1):
+- `position_ids` must be precomputed globally and passed in sharded (the
+  left-pad `cumsum` is a cross-shard scan, so it stays outside);
+- the logit head runs locally per shard (vocab projection is position-local);
+- sampling still uses the single-shard KV-cache path; SP targets the
+  training/scoring passes where the O(T) activations live;
+- **params are closure-captured and therefore replicated over the sp mesh** —
+  use a dedicated sequence-parallel mesh. Composing SP with fsdp-sharded
+  params (so an fsdp×sp mesh never gathers the full tree per device) is a
+  planned follow-up (docs/ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from nanorlhf_tpu.core.config import ModelConfig
+from nanorlhf_tpu.core.model import _layer_body, _logits, rope_tables
+from nanorlhf_tpu.parallel.ring_attention import ring_attention
+
+
+def _sp_layer_body(config: ModelConfig, x, layer_params, cos, sin, key_valid,
+                   axis_name, lora_layer=None, lora_scale=1.0):
+    """One decoder layer on a sequence shard — the shared `_layer_body` with
+    its attention contraction routed around the ring."""
+
+    def ring_attn(q, k, v):
+        return ring_attention(q, k, v, key_valid, axis_name=axis_name, causal=True)
+
+    y, _ = _layer_body(config, x, layer_params, cos, sin, mask=None,
+                       kv_cache=None, cache_index=0, lora_layer=lora_layer,
+                       lora_scale=lora_scale, attn_fn=ring_attn)
+    return y
+
+
+def _sp_forward_local(params, config: ModelConfig, input_ids, attention_mask,
+                      position_ids, axis_name, lora_scale, remat):
+    """Runs inside shard_map: all [B, T_local] shards of the global batch."""
+    attention_mask = attention_mask.astype(bool)
+    x = params["embed_tokens"][jnp.where(attention_mask, input_ids, 0)].astype(
+        params["embed_tokens"].dtype
+    )
+    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
+    lora_layers = params.get("lora", {}).get("layers")
+
+    def body(carry, inp):
+        layer_params, lora_layer = inp
+        y = _sp_layer_body(config, carry, layer_params, cos, sin, attention_mask,
+                           axis_name, lora_layer, lora_scale)
+        return y, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
+    return _logits(config, params, x)
+
+
+def sp_forward_logits(
+    params: dict,
+    config: ModelConfig,
+    input_ids: jnp.ndarray,       # [B, T] global (T divisible by the sp axis)
+    attention_mask: jnp.ndarray,  # [B, T]
+    position_ids: jnp.ndarray,    # [B, T] global positions
+    mesh: Mesh,
+    axis_name: str = "sp",
+    lora_scale: float = 1.0,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Full-model forward with the sequence dim sharded over `axis_name`.
+
+    Returns global logits [B, T, V] (sharded over T on the mesh).
+    """
+    fn = shard_map(
+        partial(
+            _sp_forward_local, params, config,
+            axis_name=axis_name, lora_scale=lora_scale, remat=remat,
+        ),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name, None),
+    )
+    return fn(input_ids, attention_mask, position_ids)

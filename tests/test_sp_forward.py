@@ -1,0 +1,75 @@
+"""Sequence-parallel full-model forward == single-device forward."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from nanorlhf_tpu.core import ModelConfig, init_params, model_forward
+from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params
+from nanorlhf_tpu.parallel.sp import sp_forward_logits
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()), ("sp",))
+
+
+def _inputs(rng, B=2, T=32, vocab=128, pad=0):
+    ids = rng.integers(2, vocab, size=(B, T)).astype(np.int32)
+    ids[0, :5] = pad  # left padding on one row
+    mask = (ids != pad).astype(np.int32)
+    pos = np.cumsum(mask, axis=1) - mask
+    return jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos)
+
+
+def test_sp_forward_matches_single_device(rng):
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    ids, mask, pos = _inputs(rng)
+    want = np.asarray(model_forward(params, config, jnp.where(mask.astype(bool), ids, 0),
+                                    mask, pos))
+    got = np.asarray(sp_forward_logits(params, config, ids, mask, pos, _mesh()))
+    real = np.asarray(mask)[:, :, None]
+    np.testing.assert_allclose(got * real, want * real, rtol=2e-3, atol=2e-3)
+
+
+def test_sp_forward_with_lora(rng):
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    lora_cfg = LoraConfig(r=4, alpha=8)
+    lora = init_lora_params(config, lora_cfg, jax.random.PRNGKey(1), jnp.float32)
+    lora = jax.tree.map(
+        lambda x: x + 0.02 * jax.random.normal(jax.random.PRNGKey(2), x.shape, x.dtype),
+        lora,
+    )
+    full = {**params, "lora": lora}
+    ids, mask, pos = _inputs(rng)
+    want = np.asarray(model_forward(full, config, jnp.where(mask.astype(bool), ids, 0),
+                                    mask, pos, lora_scale=lora_cfg.scale))
+    got = np.asarray(sp_forward_logits(full, config, ids, mask, pos, _mesh(),
+                                       lora_scale=lora_cfg.scale))
+    real = np.asarray(mask)[:, :, None]
+    np.testing.assert_allclose(got * real, want * real, rtol=2e-3, atol=2e-3)
+
+
+def test_sp_forward_gradients_flow(rng):
+    """SP training viability: grads through ring attention + scan match the
+    single-device forward's grads."""
+    config = ModelConfig.qwen2_tiny(vocab_size=64)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    ids, mask, pos = _inputs(rng, B=1, T=16, vocab=64)
+    mesh = _mesh()
+
+    def loss_sp(p):
+        lg = sp_forward_logits(p, config, ids, mask, pos, mesh)
+        return jnp.sum((lg * mask[:, :, None]) ** 2)
+
+    def loss_ref(p):
+        lg = model_forward(p, config, jnp.where(mask.astype(bool), ids, 0), mask, pos)
+        return jnp.sum((lg * mask[:, :, None]) ** 2)
+
+    g_sp = jax.grad(loss_sp)(params)
+    g_ref = jax.grad(loss_ref)(params)
+    for a, b in zip(jax.tree.leaves(g_sp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3)
