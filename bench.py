@@ -11,7 +11,8 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "episodes/s/chip", "vs_baseline": N}
 
 Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
-BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2).
+BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
+BENCH_ATTENTION (xla | pallas), BENCH_LORA (1 | 0).
 """
 
 import json
@@ -36,12 +37,17 @@ def main():
     response_len = int(os.environ.get("BENCH_RESPONSE", 256))
     model_name = os.environ.get("BENCH_MODEL", "1_5b")
     n_updates = int(os.environ.get("BENCH_UPDATES", 2))
+    attention_impl = os.environ.get("BENCH_ATTENTION", "xla")
+    use_lora = os.environ.get("BENCH_LORA", "1") == "1"
+
+    import dataclasses
 
     n_dev = len(jax.devices())
     mcfg = (
         ModelConfig.qwen2_1_5b() if model_name == "1_5b"
         else ModelConfig.qwen2_tiny(vocab_size=4096)
     )
+    mcfg = dataclasses.replace(mcfg, attention_impl=attention_impl)
     dtype = jnp.bfloat16
     tok = ToyTokenizer(vocab_size=min(4096, mcfg.vocab_size))
     params = init_params(mcfg, jax.random.PRNGKey(0), dtype)
@@ -63,7 +69,7 @@ def main():
         num_mini_batches=num_mini,
         num_ppo_epochs=1,
         kl_coef=0.01,
-        use_lora=True,
+        use_lora=use_lora,
         gradient_checkpointing=True,
         mesh=MeshConfig(n_dev, 1, 1),
         save_steps=0,
@@ -106,6 +112,8 @@ def main():
         "vs_baseline": round(eps_per_sec_per_chip / baseline_eps_per_sec, 4),
         "detail": {
             "model": model_name,
+            "attention": attention_impl,
+            "lora": use_lora,
             "prompts_per_update": episodes_per_update,
             "sample_n": sample_n,
             "response_length": response_len,

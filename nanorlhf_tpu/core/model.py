@@ -215,7 +215,7 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
 
 
 def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
-                lora_scale=1.0, remat=False):
+                lora_scale=1.0, remat=False, attn_fn=None):
     """Scan the stacked layer params over the layer body.
 
     `remat=True` wraps the body in jax.checkpoint — the training path's
@@ -229,7 +229,7 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
         def body(carry, inp):
             layer_params, lora_layer = inp
             y, _ = _layer_body(config, carry, layer_params, cos, sin, mask, None, 0,
-                               lora_layer, lora_scale)
+                               lora_layer, lora_scale, attn_fn=attn_fn)
             return y, None
 
         if remat:
@@ -278,9 +278,14 @@ def model_forward(
 
 
 def _hidden_from_inputs(params, config, input_ids, attention_mask, position_ids,
-                        lora_scale, remat):
+                        lora_scale, remat, attn_fn=None):
     """embed → rope → causal+padding mask → scanned layers. The one copy of
-    this recipe; every forward entrypoint goes through it."""
+    this recipe; every forward entrypoint goes through it.
+
+    `attn_fn` overrides the attention contraction (sequence-parallel ring
+    path); the local causal mask is then unused — the override builds its own
+    mask from global positions.
+    """
     attention_mask = attention_mask.astype(bool)
     x = params["embed_tokens"][input_ids].astype(params["embed_tokens"].dtype)
     T = input_ids.shape[1]
@@ -288,7 +293,7 @@ def _hidden_from_inputs(params, config, input_ids, attention_mask, position_ids,
     causal = jnp.tril(jnp.ones((T, T), bool))
     mask = causal[None, None, :, :] & attention_mask[:, None, None, :]
     x, _ = _run_layers(config, params, x, cos, sin, mask,
-                       lora_scale=lora_scale, remat=remat)
+                       lora_scale=lora_scale, remat=remat, attn_fn=attn_fn)
     return x
 
 

@@ -29,43 +29,23 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from nanorlhf_tpu.core.config import ModelConfig
-from nanorlhf_tpu.core.model import _layer_body, _logits, rope_tables
+from nanorlhf_tpu.core.model import _hidden_from_inputs, _logits
 from nanorlhf_tpu.parallel.ring_attention import ring_attention
-
-
-def _sp_layer_body(config: ModelConfig, x, layer_params, cos, sin, key_valid,
-                   axis_name, lora_layer=None, lora_scale=1.0):
-    """One decoder layer on a sequence shard — the shared `_layer_body` with
-    its attention contraction routed around the ring."""
-
-    def ring_attn(q, k, v):
-        return ring_attention(q, k, v, key_valid, axis_name=axis_name, causal=True)
-
-    y, _ = _layer_body(config, x, layer_params, cos, sin, mask=None,
-                       kv_cache=None, cache_index=0, lora_layer=lora_layer,
-                       lora_scale=lora_scale, attn_fn=ring_attn)
-    return y
 
 
 def _sp_forward_local(params, config: ModelConfig, input_ids, attention_mask,
                       position_ids, axis_name, lora_scale, remat):
-    """Runs inside shard_map: all [B, T_local] shards of the global batch."""
-    attention_mask = attention_mask.astype(bool)
-    x = params["embed_tokens"][jnp.where(attention_mask, input_ids, 0)].astype(
-        params["embed_tokens"].dtype
+    """Runs inside shard_map: the shared forward recipe with the attention
+    contraction routed around the ring (no duplicated embed/scan logic)."""
+    key_valid = attention_mask.astype(bool)
+
+    def ring_attn(q, k, v):
+        return ring_attention(q, k, v, key_valid, axis_name=axis_name, causal=True)
+
+    x = _hidden_from_inputs(
+        params, config, jnp.where(key_valid, input_ids, 0), attention_mask,
+        position_ids, lora_scale, remat, attn_fn=ring_attn,
     )
-    cos, sin = rope_tables(position_ids, config.actual_head_dim, config.rope_theta)
-    lora_layers = params.get("lora", {}).get("layers")
-
-    def body(carry, inp):
-        layer_params, lora_layer = inp
-        y = _sp_layer_body(config, carry, layer_params, cos, sin, attention_mask,
-                           axis_name, lora_layer, lora_scale)
-        return y, None
-
-    if remat:
-        body = jax.checkpoint(body)
-    x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
     return _logits(config, params, x)
 
 

@@ -97,8 +97,9 @@ def normalize_math_answer(ans: str) -> str:
     # drop trailing units-ish words after a number, thousands separators
     s = s.replace(",\\!", "").replace("{,}", "")
     s = re.sub(r"(?<=\d),(?=\d{3}\b)", "", s)
-    # leading "x=" style assignment
+    # leading "x=" / "x \in" style assignment prefixes
     s = re.sub(r"^[a-zA-Z]\s*=\s*", "", s)
+    s = re.sub(r"^[a-zA-Z]\s*\\in\s*", "", s)
     # 0.5 -> .5 canonicalization (match MATH convention: strip leading 0)
     s = re.sub(r"(?<![\d.])0\.(\d)", r".\1", s)
     s = s.replace(" ", "")
@@ -115,6 +116,11 @@ def normalize_math_answer(ans: str) -> str:
 def _latex_to_sympy_str(s: str) -> str:
     """Light latex → sympy-parsable conversion for common answer shapes."""
     out = s
+    # mixed numbers first: [-]N\frac{a}{b} means ±(N + a/b) — the sign
+    # applies to the whole mixed number, so -1\frac{1}{2} = -1.5, not -0.5
+    mixed = re.compile(r"(-?)(\d+)\\frac\{([^{}]*)\}\{([^{}]*)\}")
+    while mixed.search(out):
+        out = mixed.sub(r"\1((\2)+((\3)/(\4)))", out)
     # \frac{a}{b} -> (a)/(b), applied repeatedly for nesting
     frac = re.compile(r"\\frac\{([^{}]*)\}\{([^{}]*)\}")
     while frac.search(out):
@@ -162,6 +168,28 @@ def _sympy_equal(a: str, b: str) -> bool:
         return False
 
 
+def _expand_pm(s: str) -> list[str]:
+    """a\\pm b → [a+b, a-b] (first \\pm only; recursion covers multiples)."""
+    if "\\pm" not in s:
+        return [s]
+    plus = s.replace("\\pm", "+", 1)
+    minus = s.replace("\\pm", "-", 1)
+    return _expand_pm(plus) + _expand_pm(minus)
+
+
+def _branch_set(s: str) -> list[str]:
+    """Branches of a \\pm expression, or the comma-separated members of an
+    explicit pair/set written as {a,b} / (a,b) / a,b."""
+    if "\\pm" in s:
+        return _expand_pm(s)
+    body = s
+    if len(body) >= 2 and (body[0], body[-1]) in {("{", "}"), ("(", ")")}:
+        body = body[1:-1]
+    if "," in body:
+        return [p for p in body.split(",") if p]
+    return [s]
+
+
 def math_answers_equal(pred: str, gt: str) -> bool:
     """String match → normalized match → tuple/interval recurse → numeric →
     sympy symbolic. No subprocess here — wrap in call_with_timeout for that."""
@@ -174,6 +202,14 @@ def math_answers_equal(pred: str, gt: str) -> bool:
         return True
     if not a or not b:
         return False
+    # \pm answers: the branch SETS must match symmetrically, and an explicit
+    # pair/set on the other side counts as its branches (2\pm 1 == {1, 3})
+    if "\\pm" in a or "\\pm" in b:
+        ea, eb = _branch_set(a), _branch_set(b)
+        return (
+            all(any(math_answers_equal(x, y) for y in eb) for x in ea)
+            and all(any(math_answers_equal(x, y) for x in ea) for y in eb)
+        )
     # tuples/intervals: compare element-wise when separators match
     if (a[0], a[-1]) in {("(", ")"), ("[", "]")} and (b[0], b[-1]) == (a[0], a[-1]) \
             and "," in a and "," in b:

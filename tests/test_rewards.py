@@ -79,6 +79,32 @@ class TestEquivalence:
     def test_not_equal(self, a, b):
         assert not math_answers_equal(a, b)
 
+    @pytest.mark.parametrize(
+        "a,b",
+        [
+            (r"1\frac{1}{2}", "1.5"),                  # mixed number
+            (r"2\frac{3}{4}", "11/4"),
+            (r"-1\frac{1}{2}", "-1.5"),                # sign covers the whole
+            (r"2\pm\sqrt{4}", r"2\pm 2"),              # pm sets match
+            (r"2\pm 1", "{1, 3}"),                     # pm vs explicit set
+            (r"2\pm\sqrt{4}", "(0, 4)"),
+            (r"x \in (0, 1)", "(0,1)"),                # \in prefix stripped
+        ],
+    )
+    def test_extended_equal(self, a, b):
+        assert math_answers_equal(a, b)
+
+    @pytest.mark.parametrize(
+        "a,b",
+        [
+            (r"2\pm 1", r"2\pm 5"),
+            (r"-1\frac{1}{2}", "-0.5"),                # the sign-scope trap
+            (r"2\pm 0", r"3\pm 1"),                    # asymmetric-set trap
+        ],
+    )
+    def test_extended_not_equal(self, a, b):
+        assert not math_answers_equal(a, b)
+
     def test_is_correct_subprocess_survives_bomb(self):
         # adversarial: enormous power tower must time out to False, not hang
         assert is_correct("2**(2**(2**100000))", "5", timeout=0.2) is False
