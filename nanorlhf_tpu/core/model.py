@@ -326,9 +326,21 @@ def padded_forward_logits(
     pad_token_id: int,
     lora_scale: float = 1.0,
     remat: bool = False,
+    response_context_length: int | None = None,
 ) -> jnp.ndarray:
-    """Padding-robust forward: the reference's shared `forward()` contract."""
+    """Padding-robust forward: the reference's shared `forward()` contract.
+
+    `response_context_length=ctx` returns next-token logits for the response
+    positions only — hidden states are sliced `[ctx-1:-1]` BEFORE the vocab
+    projection, so the lm_head never runs over prompt positions (the
+    reference slices logits after computing all of them,
+    `GRPO/grpo_trainer.py:546`; at 152k vocab the discarded prompt logits
+    are the single largest wasted tensor in the update pass). The shift-by-
+    one next-token convention lives here, in one place.
+    """
     x = _padded_hidden(params, config, query_responses, pad_token_id, lora_scale, remat)
+    if response_context_length is not None:
+        x = x[:, response_context_length - 1 : -1]
     return _logits(config, params, x)
 
 

@@ -96,11 +96,13 @@ class SparseGRPOTrainer(RLTrainer):
             resp = qr[:, context_length:]
             lp = logprobs_from_logits(
                 padded_forward_logits(params, mcfg, qr, pad_id,
-                                      lora_scale=lora_scale)[:, context_length - 1 : -1],
+                                      lora_scale=lora_scale,
+                                      response_context_length=context_length),
                 resp, cfg.temperature,
             )
             rlp = logprobs_from_logits(
-                padded_forward_logits(ref_params, mcfg, qr, pad_id)[:, context_length - 1 : -1],
+                padded_forward_logits(ref_params, mcfg, qr, pad_id,
+                                      response_context_length=context_length),
                 resp, cfg.temperature,
             )
             return lp, rlp
@@ -122,7 +124,8 @@ class SparseGRPOTrainer(RLTrainer):
             logits = padded_forward_logits(
                 tree["policy"], mcfg, mb["query_responses"], pad_id,
                 lora_scale=lora_scale, remat=remat,
-            )[:, context_length - 1 : -1]
+                response_context_length=context_length,
+            )
             new_lp = logprobs_from_logits(logits, mb["responses"], cfg.temperature)
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
             loss, aux = grpo_loss(

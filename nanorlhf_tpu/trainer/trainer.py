@@ -266,7 +266,8 @@ class RLTrainer:
             logits = padded_forward_logits(
                 train_tree["policy"], mcfg, mb["query_responses"], pad_id,
                 lora_scale=lora_scale, remat=remat,
-            )[:, context_length - 1 : -1]
+                response_context_length=context_length,
+            )
             new_logprobs = logprobs_from_logits(
                 logits, mb["responses"], cfg.temperature
             )
@@ -375,12 +376,14 @@ class RLTrainer:
         def score(params, ref_params, query_responses, context_length: int):
             responses = query_responses[:, context_length:]
             logits = padded_forward_logits(
-                params, mcfg, query_responses, pad_id, lora_scale=lora_scale
-            )[:, context_length - 1 : -1]
+                params, mcfg, query_responses, pad_id, lora_scale=lora_scale,
+                response_context_length=context_length,
+            )
             logprobs = logprobs_from_logits(logits, responses, cfg.temperature)
             ref_logits = padded_forward_logits(
-                ref_params, mcfg, query_responses, pad_id
-            )[:, context_length - 1 : -1]
+                ref_params, mcfg, query_responses, pad_id,
+                response_context_length=context_length,
+            )
             ref_logprobs = logprobs_from_logits(ref_logits, responses, cfg.temperature)
             return logprobs, ref_logprobs
 

@@ -98,11 +98,13 @@ def finetune_value_model(
         resp = ids[:, ctx:]
         lp = logprobs_from_logits(
             padded_forward_logits(p, model_config, ids, pad_id,
-                                  lora_scale=lora_scale)[:, ctx - 1 : -1],
+                                  lora_scale=lora_scale,
+                                  response_context_length=ctx),
             resp, temperature,
         )
         rlp = logprobs_from_logits(
-            padded_forward_logits(rp, model_config, ids, pad_id)[:, ctx - 1 : -1],
+            padded_forward_logits(rp, model_config, ids, pad_id,
+                                  response_context_length=ctx),
             resp, temperature,
         )
         return lp, rlp

@@ -80,6 +80,21 @@ def test_logit_parity_right_padded(tiny_pair, rng):
         np.testing.assert_allclose(got[b, :l], want[b, :l], rtol=2e-4, atol=2e-4)
 
 
+def test_response_context_slice_equals_post_hoc_slice(tiny_pair, rng):
+    """response_context_length=k must equal full logits sliced [k-1:-1] —
+    the shift-by-one next-token convention lives in one place."""
+    import jax.numpy as jnp
+    from nanorlhf_tpu.core import padded_forward_logits
+
+    _, config, params = tiny_pair
+    ids = jnp.asarray(rng.integers(2, 512, size=(2, 14)).astype(np.int32))
+    for ctx in (1, 5, 10):
+        full = padded_forward_logits(params, config, ids, 0)[:, ctx - 1 : -1]
+        sliced = padded_forward_logits(params, config, ids, 0,
+                                       response_context_length=ctx)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(sliced))
+
+
 def test_untied_lm_head(rng):
     from transformers import Qwen2Config, Qwen2ForCausalLM
 
