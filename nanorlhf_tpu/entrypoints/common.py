@@ -45,9 +45,10 @@ def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "xla
 
 def resolve_dataset(cfg: RLConfig, tokenizer, max_prompt_len: int = 256):
     """hh-rlhf when the datasets cache has it; synthetic corpus otherwise."""
-    name = getattr(cfg, "train_dataset_name", "Anthropic/hh-rlhf")
+    name = cfg.train_dataset_name
     try:
-        return load_prompt_dataset(name, tokenizer, max_prompt_len=max_prompt_len)
+        return load_prompt_dataset(name, tokenizer, split=cfg.train_dataset_split,
+                                   max_prompt_len=max_prompt_len)
     except Exception as e:  # zero-egress / no local cache
         print(f"[offline demo] dataset '{name}' unavailable ({type(e).__name__}) — "
               "synthetic prompts")

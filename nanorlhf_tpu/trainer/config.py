@@ -44,6 +44,10 @@ class RLConfig:
     sft_model_path: str = ""
     reward_model_path: str = ""
 
+    # ---- data ----
+    train_dataset_name: str = "Anthropic/hh-rlhf"   # (`GRPO/grpo.py:101`)
+    train_dataset_split: str = "train"              # (`GRPO/grpo.py:102`)
+
     # ---- rollout / sampling ----
     response_length: int = 1500          # max new tokens (`GRPO/grpo.py:125`)
     temperature: float = 0.9
@@ -53,7 +57,10 @@ class RLConfig:
     missing_eos_penalty: Optional[float] = None
 
     # ---- batch hierarchy ----
-    total_episodes: int = 10000
+    # total_episodes=None → num_train_epochs × dataset size, resolved by the
+    # trainer (`GRPO/grpo_trainer.py:216-217`)
+    total_episodes: Optional[int] = 10000
+    num_train_epochs: float = 1.0
     per_device_train_batch_size: int = 4
     gradient_accumulation_steps: int = 8
     num_mini_batches: int = 16

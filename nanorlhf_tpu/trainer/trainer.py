@@ -120,6 +120,14 @@ class RLTrainer:
         self.algo = config.algo
 
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        if config.total_episodes is None:
+            # episodes-from-epochs parity (`GRPO/grpo_trainer.py:216-217`)
+            if not hasattr(dataset, "__len__"):
+                raise ValueError(
+                    "total_episodes=None needs a sized dataset (e.g. "
+                    "PromptDataset) to derive episodes from num_train_epochs"
+                )
+            config.total_episodes = int(config.num_train_epochs * len(dataset))
         config.finalize(self.mesh.devices.size)
 
         self.key = rng_key if rng_key is not None else jax.random.PRNGKey(config.seed)

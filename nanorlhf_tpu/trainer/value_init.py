@@ -45,6 +45,10 @@ class ValueInitConfig:
     learning_rate: float = 5e-5
     train_split_rate: float = 0.8
     early_stopping_patience: int = 3
+    # reduce-on-plateau parity (`PPO/ppo.py:92-98`: factor 0.5, patience 0 —
+    # halve on every non-improving eval)
+    plateau_factor: float = 0.5
+    plateau_patience: int = 0
 
 
 def finetune_value_model(
@@ -146,7 +150,11 @@ def finetune_value_model(
     perm = np.random.default_rng(0).permutation(n)
     tr, va = perm[:n_train], perm[n_train:]
 
-    optimizer = optax.adam(vcfg.learning_rate)
+    # reduce-on-plateau via an inject_hyperparams LR the host halves when the
+    # val loss stalls (the reference's lr_scheduler_type, `PPO/ppo.py:92-93`)
+    optimizer = optax.inject_hyperparams(optax.adam)(
+        learning_rate=vcfg.learning_rate
+    )
     opt_state = optimizer.init(value_params)
 
     def vloss(vp, ids, labels, pm1):
@@ -164,6 +172,7 @@ def finetune_value_model(
 
     bs = vcfg.per_device_train_batch_size
     best_val, best_params, patience = np.inf, value_params, 0
+    plateau_wait = 0
     for epoch in range(vcfg.num_train_epochs):
         ep_perm = np.random.default_rng(epoch).permutation(len(tr))
         for i in range(0, len(tr) - bs + 1, bs):
@@ -182,8 +191,15 @@ def finetune_value_model(
         print(f"[value-init] epoch {epoch}: val_loss={val_loss:.5f}")
         if val_loss < best_val - 1e-6:
             best_val, best_params, patience = val_loss, value_params, 0
+            plateau_wait = 0
         else:
             patience += 1
+            plateau_wait += 1
+            if plateau_wait > vcfg.plateau_patience:
+                new_lr = float(opt_state.hyperparams["learning_rate"]) * vcfg.plateau_factor
+                opt_state.hyperparams["learning_rate"] = jnp.asarray(new_lr)
+                print(f"[value-init] plateau: lr -> {new_lr:.2e}")
+                plateau_wait = 0
             if patience >= vcfg.early_stopping_patience:
                 break
     return best_params

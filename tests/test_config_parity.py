@@ -1,0 +1,39 @@
+"""Config-surface parity additions: dataset fields, episodes-from-epochs."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+from nanorlhf_tpu.parallel import MeshConfig
+from nanorlhf_tpu.trainer import RLConfig, AlgoName, RLTrainer
+
+
+def test_dataset_fields_exist():
+    cfg = RLConfig()
+    assert cfg.train_dataset_name == "Anthropic/hh-rlhf"
+    assert cfg.train_dataset_split == "train"
+
+
+def test_total_episodes_none_uses_epochs(tmp_path):
+    tok = ToyTokenizer(256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    ds = load_prompt_dataset("synthetic:64", tok, max_prompt_len=8)
+    cfg = RLConfig(
+        algo=AlgoName.REINFORCE, output_dir=str(tmp_path / "ep"),
+        total_episodes=None, num_train_epochs=2.0,
+        response_length=4, sample_n=1,
+        per_device_train_batch_size=1, gradient_accumulation_steps=1,
+        num_mini_batches=1, use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False, mesh=MeshConfig(-1, 1, 1), save_steps=0,
+    )
+
+    def reward(prs, eos):
+        return np.zeros(len(prs), np.float32)
+
+    trainer = RLTrainer(cfg, mcfg, tok, params, ds, reward)
+    assert cfg.total_episodes == 128          # 2 epochs × 64 prompts
+    assert cfg.num_total_batches == 128 // cfg.batch_size
