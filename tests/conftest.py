@@ -23,6 +23,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
+# Persistent XLA compilation cache: jit compiles dominate suite wall time on
+# small hosts; repeat runs (CI / driver rounds) reuse executables from disk.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    # persist even sub-second compiles: tiny-model suites are made of them
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass  # older jax without the persistent cache — suite still runs
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
