@@ -215,19 +215,25 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
 
 
 def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
-                lora_scale=1.0, remat=False, attn_fn=None):
+                lora_scale=1.0, remat=False, attn_fn=None, layer_transform=None):
     """Scan the stacked layer params over the layer body.
 
     `remat=True` wraps the body in jax.checkpoint — the training path's
     activation rematerialization (capability parity with the reference's
     `gradient_checkpointing=True`, `/root/reference/GRPO/grpo.py:134`, but
     trading FLOPs for HBM the XLA way).
+
+    `layer_transform(layer_params, lora_layer) -> (layer_params, lora_layer)`
+    runs inside the scan body before the layer math — the FSDP hook: scanned
+    param slices enter as shards and are all-gathered one layer at a time.
     """
     lora_layers = params.get("lora", {}).get("layers") if isinstance(params, dict) else None
 
     if kv_caches is None:
         def body(carry, inp):
             layer_params, lora_layer = inp
+            if layer_transform is not None:
+                layer_params, lora_layer = layer_transform(layer_params, lora_layer)
             y, _ = _layer_body(config, carry, layer_params, cos, sin, mask, None, 0,
                                lora_layer, lora_scale, attn_fn=attn_fn)
             return y, None
@@ -278,7 +284,7 @@ def model_forward(
 
 
 def _hidden_from_inputs(params, config, input_ids, attention_mask, position_ids,
-                        lora_scale, remat, attn_fn=None):
+                        lora_scale, remat, attn_fn=None, layer_transform=None):
     """embed → rope → causal+padding mask → scanned layers. The one copy of
     this recipe; every forward entrypoint goes through it.
 
@@ -293,7 +299,8 @@ def _hidden_from_inputs(params, config, input_ids, attention_mask, position_ids,
     causal = jnp.tril(jnp.ones((T, T), bool))
     mask = causal[None, None, :, :] & attention_mask[:, None, None, :]
     x, _ = _run_layers(config, params, x, cos, sin, mask,
-                       lora_scale=lora_scale, remat=remat, attn_fn=attn_fn)
+                       lora_scale=lora_scale, remat=remat, attn_fn=attn_fn,
+                       layer_transform=layer_transform)
     return x
 
 

@@ -1,6 +1,6 @@
 from nanorlhf_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules, shard_params, batch_sharding
 from nanorlhf_tpu.parallel.ring_attention import ring_attention
-from nanorlhf_tpu.parallel.sp import sp_forward_logits
+from nanorlhf_tpu.parallel.sp import sp_forward_logits, sp_fsdp_forward_logits
 from nanorlhf_tpu.parallel.distributed import initialize_multihost, broadcast_host_value
 
 __all__ = [
@@ -11,6 +11,7 @@ __all__ = [
     "batch_sharding",
     "ring_attention",
     "sp_forward_logits",
+    "sp_fsdp_forward_logits",
     "initialize_multihost",
     "broadcast_host_value",
 ]

@@ -74,3 +74,121 @@ def sp_forward_logits(
         out_specs=P(None, axis_name, None),
     )
     return fn(input_ids, attention_mask, position_ids)
+
+
+# ---------------------------------------------------------------------------
+# SP × FSDP: params sharded at rest, gathered per layer inside the scan
+# ---------------------------------------------------------------------------
+
+
+def _fsdp_specs(params, fsdp_axis: str):
+    """Per-leaf PartitionSpecs for this mesh: keep the fsdp placements from
+    the framework's sharding rules, drop the (absent) tensor axis."""
+    from nanorlhf_tpu.parallel.mesh import param_sharding_rules
+
+    rules = param_sharding_rules(params)
+
+    def remap(spec):
+        return P(*[fsdp_axis if a == "fsdp" else None for a in spec])
+
+    return jax.tree.map(remap, rules, is_leaf=lambda x: isinstance(x, P))
+
+
+def _gather_by_spec(tree, specs, axis_name: str, skip_leading_dim: bool = False):
+    """all_gather each leaf along the dims its spec marks as fsdp-sharded.
+
+    `skip_leading_dim=True` for per-layer slices inside the scan: their spec
+    still names the stacked [L, ...] layout, whose leading dim the scan has
+    already consumed.
+    """
+
+    def gather(leaf, spec):
+        dims = list(spec)
+        if skip_leading_dim:
+            dims = dims[1:]
+        for dim, ax in enumerate(dims):
+            if ax == axis_name:
+                leaf = jax.lax.all_gather(leaf, axis_name, axis=dim, tiled=True)
+        return leaf
+
+    return jax.tree.map(gather, tree, specs)
+
+
+def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
+                           params_local, input_ids, attention_mask, position_ids):
+    """Inside shard_map over (fsdp, sp): sequence shard local, params shards
+    gathered — embeddings up front (the lookup needs them), layer leaves one
+    scan step at a time via the shared recipe's `layer_transform` hook, the
+    lm_head lazily after the scan (ZeRO-3 execution model). Gradients flow
+    back through all_gather's transpose (reduce-scatter), so grads come out
+    sharded exactly like the params."""
+    key_valid = attention_mask.astype(bool)
+
+    def ring_attn(q, k, v):
+        return ring_attention(q, k, v, key_valid, axis_name=sp_axis, causal=True)
+
+    lora_specs = specs.get("lora", {}).get("layers")
+
+    def gather_layer(layer_local, lora_local):
+        layer_full = _gather_by_spec(
+            layer_local, specs["layers"], fsdp_axis, skip_leading_dim=True
+        )
+        lora_full = (
+            _gather_by_spec(lora_local, lora_specs, fsdp_axis, skip_leading_dim=True)
+            if lora_local is not None else None
+        )
+        return layer_full, lora_full
+
+    embed_full = _gather_by_spec(
+        params_local["embed_tokens"], specs["embed_tokens"], fsdp_axis
+    )
+    params_mixed = {**params_local, "embed_tokens": embed_full}
+    x = _hidden_from_inputs(
+        params_mixed, config, jnp.where(key_valid, input_ids, 0), attention_mask,
+        position_ids, lora_scale, remat, attn_fn=ring_attn,
+        layer_transform=gather_layer,
+    )
+    # lm_head / final norm gathered only now (tied models reuse embed_full)
+    head = {"embed_tokens": embed_full,
+            "norm": _gather_by_spec(params_local["norm"], specs["norm"], fsdp_axis)}
+    if not config.tie_word_embeddings:
+        head["lm_head"] = _gather_by_spec(
+            params_local["lm_head"], specs["lm_head"], fsdp_axis
+        )
+    return _logits(config, head, x)
+
+
+def sp_fsdp_forward_logits(
+    params: dict,
+    config: ModelConfig,
+    input_ids: jnp.ndarray,
+    attention_mask: jnp.ndarray,
+    position_ids: jnp.ndarray,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    fsdp_axis: str = "fsdp",
+    lora_scale: float = 1.0,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Sequence-parallel forward with fsdp-sharded parameters (roadmap #7).
+
+    Params enter through shard_map in_specs with the framework's fsdp
+    placements — sharded at rest, all-gathered one layer at a time inside the
+    scan — while the sequence dim shards over `sp_axis`. Peak param memory
+    per device ≈ params/n_fsdp + one full layer + the full embedding table
+    (and, for untied models, the lm_head while computing logits) — the
+    embedding must be whole for the lookup and the head for the projection.
+    """
+    specs = _fsdp_specs(params, fsdp_axis)
+    fn = shard_map(
+        partial(_sp_fsdp_forward_local, config, specs, sp_axis, fsdp_axis,
+                lora_scale, remat),
+        mesh=mesh,
+        in_specs=(specs, P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
+        out_specs=P(None, sp_axis, None),
+        # logits are fsdp-replicated by construction (every member gathered
+        # identical weights), which vma inference can't prove through
+        # all_gather — the parity tests assert it instead
+        check_vma=False,
+    )
+    return fn(params, input_ids, attention_mask, position_ids)
