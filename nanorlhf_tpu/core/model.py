@@ -319,11 +319,21 @@ def _padded_hidden(
     single source of truth for both the policy logit pass and the value/RM
     score pass — their padding numerics must never drift apart.
     """
+    input_ids, attention_mask, position_ids = padding_inputs(
+        query_responses, pad_token_id
+    )
+    return _hidden_from_inputs(params, config, input_ids, attention_mask,
+                               position_ids, lora_scale, remat)
+
+
+def padding_inputs(query_responses: jnp.ndarray, pad_token_id: int):
+    """(input_ids, attention_mask, position_ids) from padded token ids — the
+    single copy of the reference's padding recipe, shared by every scorer
+    (incl. the sequence-parallel paths in parallel/sp.py)."""
     attention_mask = query_responses != pad_token_id
     position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
     input_ids = jnp.where(attention_mask, query_responses, 0)
-    return _hidden_from_inputs(params, config, input_ids, attention_mask,
-                               position_ids, lora_scale, remat)
+    return input_ids, attention_mask, position_ids
 
 
 def padded_forward_logits(

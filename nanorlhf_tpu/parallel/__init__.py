@@ -1,6 +1,10 @@
 from nanorlhf_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules, shard_params, batch_sharding
 from nanorlhf_tpu.parallel.ring_attention import ring_attention
-from nanorlhf_tpu.parallel.sp import sp_forward_logits, sp_fsdp_forward_logits
+from nanorlhf_tpu.parallel.sp import (
+    sp_forward_logits,
+    sp_fsdp_forward_logits,
+    sp_score_logprobs,
+)
 from nanorlhf_tpu.parallel.distributed import initialize_multihost, broadcast_host_value
 
 __all__ = [
@@ -12,6 +16,7 @@ __all__ = [
     "ring_attention",
     "sp_forward_logits",
     "sp_fsdp_forward_logits",
+    "sp_score_logprobs",
     "initialize_multihost",
     "broadcast_host_value",
 ]

@@ -13,10 +13,10 @@ Caveats (v1):
 - the logit head runs locally per shard (vocab projection is position-local);
 - sampling still uses the single-shard KV-cache path; SP targets the
   training/scoring passes where the O(T) activations live;
-- **params are closure-captured and therefore replicated over the sp mesh** —
-  use a dedicated sequence-parallel mesh. Composing SP with fsdp-sharded
-  params (so an fsdp×sp mesh never gathers the full tree per device) is a
-  planned follow-up (docs/ROADMAP.md).
+- `sp_forward_logits` closure-captures params (replicated over the sp mesh):
+  right for dedicated-SP meshes. For fsdp×sp meshes use
+  `sp_fsdp_forward_logits` / `sp_score_logprobs(fsdp_axis=...)` below —
+  params stay sharded at rest and gather one layer at a time.
 """
 
 from __future__ import annotations
@@ -156,6 +156,77 @@ def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
             params_local["lm_head"], specs["lm_head"], fsdp_axis
         )
     return _logits(config, head, x)
+
+
+def sp_score_logprobs(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,   # [B, T] global, T divisible by sp axis
+    pad_token_id: int,
+    temperature: float,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    fsdp_axis: str | None = None,
+    lora_scale: float = 1.0,
+) -> jnp.ndarray:
+    """Per-position next-token logprobs [B, T] under sequence parallelism —
+    the scoring primitive for beyond-one-device contexts (the RL logprob
+    pass, `/root/reference/GRPO/grpo_trainer.py:534-556`, at ring scale).
+
+    Entry t holds log p(token_{t+1} | tokens_{<=t}); the final position is 0
+    (no next token). Labels cross shard boundaries, so each shard fetches its
+    right neighbor's first token via ppermute. Callers slice
+    `[:, ctx-1:T-1]` for response logprobs exactly as in the single-device
+    path. `fsdp_axis` switches the underlying forward to the
+    params-sharded-at-rest variant.
+    """
+    from nanorlhf_tpu.core.model import padding_inputs
+    from nanorlhf_tpu.ops.masking import logprobs_from_logits
+
+    _, attention_mask, position_ids = padding_inputs(query_responses, pad_token_id)
+    attention_mask = attention_mask.astype(jnp.int32)
+
+    n_sp = mesh.shape[sp_axis]
+
+    def local_score(logits_local, ids_local):
+        # label for local position t = ids[t+1]; last local label comes from
+        # the right neighbor's first token (left rotation around the ring)
+        perm = [(i, (i - 1) % n_sp) for i in range(n_sp)]
+        from_right = jax.lax.ppermute(ids_local[:, :1], sp_axis, perm)
+        labels = jnp.concatenate([ids_local[:, 1:], from_right], axis=1)
+        return logprobs_from_logits(logits_local, labels, temperature)
+
+    if fsdp_axis is not None:
+        specs = _fsdp_specs(params, fsdp_axis)
+
+        def fn(params_local, ids, mask, pos):
+            logits = _sp_fsdp_forward_local(
+                config, specs, sp_axis, fsdp_axis, lora_scale, False,
+                params_local, ids, mask, pos,
+            )
+            return local_score(logits, ids)
+
+        lp = shard_map(
+            fn, mesh=mesh,
+            in_specs=(specs, P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
+            out_specs=P(None, sp_axis),
+            check_vma=False,
+        )(params, query_responses, attention_mask, position_ids)
+    else:
+        def fn(ids, mask, pos):
+            logits = _sp_forward_local(
+                params, config, ids, mask, pos,
+                axis_name=sp_axis, lora_scale=lora_scale, remat=False,
+            )
+            return local_score(logits, ids)
+
+        lp = shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
+            out_specs=P(None, sp_axis),
+        )(query_responses, attention_mask, position_ids)
+    # final global position has no next token
+    return lp.at[:, -1].set(0.0)
 
 
 def sp_fsdp_forward_logits(
