@@ -74,6 +74,28 @@ def test_reinforce_smoke(tmp_path):
     assert (tmp_path / "reinforce" / "checkpoint-2").exists()
 
 
+def test_multiple_ppo_epochs_go_off_policy(tmp_path):
+    """num_ppo_epochs=2: the second epoch re-fits on stale rollouts, so the
+    importance ratio must move off 1 (the clipping machinery is live) while
+    the run stays finite — the off-policy capability the reference's losses
+    exist for (`REINFORCE/reinforce_trainer.py:637` comment)."""
+    import json
+
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=16,
+                      num_ppo_epochs=2, learning_rate=5e-3)
+    tr.train()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "grpo" / "metrics.jsonl")
+        if "samples" not in l
+    ]
+    m = lines[-1]
+    # averaged over both epochs the ratio reflects epoch-2 drift
+    assert np.isfinite(m["val/ratio_new"])
+    assert m["policy/approxkl_avg_new"] > 0, "second epoch produced no drift"
+    assert np.isfinite(m["loss/policy_avg_new"])
+
+
 @pytest.mark.parametrize(
     "algo", [AlgoName.GRPO, AlgoName.RLOO, AlgoName.RAFT, AlgoName.REMAX, AlgoName.PPO]
 )
