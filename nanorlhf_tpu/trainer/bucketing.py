@@ -67,6 +67,18 @@ def round_up_to_menu(value: int, menu: list[int]) -> int:
     return menu[-1]
 
 
+def depad_queries(queries: np.ndarray, pad_id: int, menu: list[int]) -> np.ndarray:
+    """Strip the batch's common left-pad, menu-rounded — the r1 de-padding
+    move (`grpo_r1_trainer.py:571-574`) as a shared host-side helper. Pure
+    numpy: the batch is already on the host and the result is one slice; no
+    device round-trip belongs on the rollout hot path."""
+    nz = queries != pad_id
+    q_pad = np.where(nz.any(axis=1), nz.argmax(axis=1), queries.shape[1])
+    ctx_needed = queries.shape[1] - int(q_pad.min())
+    ctx = min(round_up_to_menu(max(ctx_needed, 1), menu), queries.shape[1])
+    return queries[:, queries.shape[1] - ctx:]
+
+
 def pad_rows(arrays: dict, n_rows: int, fill: dict):
     """Pad each [B, ...] array in `arrays` to n_rows with fill values.
 

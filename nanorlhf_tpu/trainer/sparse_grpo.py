@@ -242,11 +242,10 @@ class SparseGRPOTrainer(RLTrainer):
             scores, queries_f, responses_f = scores[nz], queries[nz], responses[nz]
 
             # ---- de-pad (`:571-582`), menu-rounded ------------------------
-            q_pad = np.asarray(first_true_indices(jnp.asarray(queries_f) != pad_id))
-            ctx_needed = queries_f.shape[1] - int(q_pad.min())
-            context_length = round_up_to_menu(ctx_needed, self._len_menu)
-            context_length = min(context_length, queries_f.shape[1])
-            queries_f = queries_f[:, queries_f.shape[1] - context_length:]
+            from nanorlhf_tpu.trainer.bucketing import depad_queries
+
+            queries_f = depad_queries(queries_f, pad_id, self._len_menu)
+            context_length = queries_f.shape[1]
 
             post = np.asarray(truncate_response(eos_id, pad_id, jnp.asarray(responses_f)))
             resp_len = np.asarray(first_true_indices(jnp.asarray(post) == pad_id))

@@ -421,10 +421,20 @@ class RLTrainer:
             max(0, cfg.num_total_batches - self.state["global_step"])
             if num_updates is None else num_updates
         )
+        from nanorlhf_tpu.trainer.bucketing import depad_queries, shape_menu
+
+        ctx_menu = shape_menu(self.dataset.input_ids.shape[1], min_value=16) \
+            if hasattr(self.dataset, "input_ids") else None
+
         for update in range(1, n_updates + 1):
             t_start = time.time()
             self.state["episode"] += cfg.batch_size
             queries = np.asarray(next(self._iter))          # [B, Tp] left-padded
+            if ctx_menu is not None:
+                # r1's de-padding applied to every algorithm: batches of short
+                # prompts roll out / score at a menu-rounded context (warm jit
+                # cache) instead of the dataset-wide pad width
+                queries = depad_queries(queries, pad_id, ctx_menu)
             batch_size, context_length = queries.shape
             queries_j = jax.device_put(
                 jnp.asarray(queries), batch_sharding(self.mesh)

@@ -74,6 +74,28 @@ def test_reinforce_smoke(tmp_path):
     assert (tmp_path / "reinforce" / "checkpoint-2").exists()
 
 
+def test_rollout_context_depadding(tmp_path):
+    """Batches of short prompts train at a menu-rounded context, not the
+    dataset-wide max (r1 de-padding applied to the main trainer)."""
+    tr = make_trainer(AlgoName.REINFORCE, tmp_path, total_episodes=16)
+    # dataset padded to width 12; all synthetic prompts are much shorter than
+    # a padded-out width, so force a wide dataset pad to observe the strip
+    wide = np.full((64, 32), tr.tokenizer.pad_token_id, np.int32)
+    wide[:, -6:] = tr.dataset.input_ids[:, -6:]
+    tr.dataset.input_ids = wide
+    tr._iter = tr.dataset.loader(tr.cfg.batch_size, tr.cfg.seed)
+    seen = {}
+    orig = tr._score_chunk_fn()
+
+    def spy(params, ref_params, qr, context_length):
+        seen["ctx"] = context_length
+        return orig(params, ref_params, qr, context_length)
+
+    tr._score_fn_cached = spy
+    tr.train(num_updates=1)
+    assert seen["ctx"] <= 16, f"context not de-padded: {seen['ctx']}"
+
+
 def test_multiple_ppo_epochs_go_off_policy(tmp_path):
     """num_ppo_epochs=2: the second epoch re-fits on stale rollouts, so the
     importance ratio must move off 1 (the clipping machinery is live) while
