@@ -8,23 +8,208 @@ A100 40G (`BASELINE.md`; reference runtime print
 `/root/reference/GRPO/grpo_trainer.py:726`).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "episodes/s/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "episodes/s/chip", "vs_baseline": N,
+   "detail": {..., "mfu": ..., "tokens_per_sec": ..., "phase_split_s": ...}}
+
+On failure it STILL prints one parseable JSON line with an "error" field —
+round 1 died to a flaky TPU-backend init (hung >280 s) with a bare stack
+trace. Architecture: the PARENT process never imports jax. It spawns the
+whole measurement as a child subprocess with a hard timeout, retries with
+backoff, and finally (optional) falls back to a reduced CPU run. Only one
+jax process ever exists at a time — required by the axon TPU tunnel, which
+allows a single claim holder and can wedge if probed concurrently.
 
 Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
 BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
-BENCH_ATTENTION (xla | pallas), BENCH_LORA (1 | 0).
+BENCH_ATTENTION (xla | pallas), BENCH_LORA (1 | 0),
+BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (1500 s per attempt),
+BENCH_ALLOW_CPU_FALLBACK (1: after all TPU attempts fail, run a reduced
+bench on CPU and mark backend=cpu in the payload rather than emitting
+nothing).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+BASELINE_EPS_PER_SEC = 1.0  # reference: ~1 s/episode on one A100 40G
+
+# peak dense bf16 FLOPs/s per chip by device kind (public figures; substring
+# match on jax Device.device_kind). MFU = achieved model FLOPs / peak.
+PEAK_FLOPS = {
+    "v6": 918e12,       # Trillium / v6e
+    "v5p": 459e12,
+    "v5": 197e12,       # v5e / "TPU v5 lite"
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+CPU_PEAK_FLOPS = 1e12   # nominal; CPU-fallback MFU is not meaningful
+
+
+def _emit(payload: dict) -> None:
+    print(json.dumps(payload))
+
+
+def _error_payload(msg: str, **detail) -> dict:
+    return {
+        "metric": "grpo_episodes_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "episodes/s/chip",
+        "vs_baseline": 0.0,
+        "error": msg[-2000:],
+        "detail": detail,
+    }
+
+
+def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
+    """Run the measurement child; return (payload_or_None, error_tail).
+
+    The child is this same script with BENCH_CHILD=1. Its last stdout line
+    that parses as JSON with a "metric" key is the payload. On timeout the
+    child is killed — the parent interpreter stays clean for a retry.
+    """
+    env = {**os.environ, "BENCH_CHILD": "1", **extra_env}
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode() if isinstance(e.stderr, bytes)
+                else (e.stderr or ""))[-500:]
+        return None, f"child timed out after {timeout_s:.0f}s; stderr: {tail}"
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            payload = json.loads(line)
+            if isinstance(payload, dict) and "metric" in payload:
+                return payload, ""
+        except json.JSONDecodeError:
+            continue
+    return None, (out.stderr or out.stdout).strip()[-800:]
+
+
+def orchestrate() -> int:
+    """Parent entry: spawn children with retry/backoff, emit ONE JSON line."""
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", 2))
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 1500))
+    allow_cpu = os.environ.get("BENCH_ALLOW_CPU_FALLBACK", "1") == "1"
+
+    errors = []
+    for attempt in range(attempts):
+        payload, err = _run_child({}, timeout_s)
+        if payload is not None:
+            _emit(payload)
+            return 0
+        errors.append(f"attempt {attempt + 1}: {err}")
+        print(f"[bench] attempt {attempt + 1}/{attempts} failed: {err[:300]}",
+              file=sys.stderr)
+        if attempt < attempts - 1:
+            time.sleep(min(20 * (attempt + 1), 60))
+
+    if allow_cpu:
+        # strip only the axon site dir (its sitecustomize eagerly claims the
+        # TPU at interpreter startup and can hang the CPU child); keep any
+        # other PYTHONPATH entries the environment relies on
+        pythonpath = ":".join(
+            p for p in os.environ.get("PYTHONPATH", "").split(":")
+            if p and ".axon_site" not in p
+        )
+        payload, err = _run_child(
+            {"JAX_PLATFORMS": "cpu", "PYTHONPATH": pythonpath,
+             "BENCH_CPU_FALLBACK": "1",
+             "BENCH_TPU_ERROR": " | ".join(errors)[-500:]},
+            timeout_s,
+        )
+        if payload is not None:
+            _emit(payload)
+            return 0
+        errors.append(f"cpu fallback: {err}")
+    _emit(_error_payload(" | ".join(errors)))
+    return 0
+
+
+def count_params(tree) -> int:
+    import jax
+
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def pallas_on_chip_check(jax) -> dict:
+    """Run the Pallas flash kernel NON-interpreted and assert vs the XLA
+    reference — the first real-silicon validation (round 1 only ever ran it
+    in interpret mode on CPU)."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.ops.attention import flash_attention, reference_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        B, H, KV, T, d = 2, 8, 2, 512, 64
+    else:  # interpret mode runs the grid in Python — keep the shape tiny
+        B, H, KV, T, d = 1, 4, 2, 128, 64
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, H, T, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, KV, T, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, KV, T, d), jnp.bfloat16)
+    lens = [T] * B
+    if B > 1:
+        lens[1] = T - 100
+    key_valid = jnp.arange(T)[None, :] < jnp.asarray(lens)[:, None]
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) ** 2).sum()
+
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    def rel_err(a, b):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        return float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-6))
+
+    out_p = flash_attention(q, k, v, key_valid, causal=True)
+    out_r = reference_attention(q, k, v, key_valid, causal=True)
+    fwd_err = rel_err(out_p, out_r)
+    gp = loss(lambda q, k, v: flash_attention(q, k, v, key_valid, True))(q, k, v)
+    gr = loss(lambda q, k, v: reference_attention(q, k, v, key_valid, True))(q, k, v)
+    bwd_err = max(rel_err(a, b) for a, b in zip(gp, gr))
+    tol = 0.02  # relative; bf16 inputs, f32 accumulation
+    status = "ok" if (fwd_err < tol and bwd_err < tol) else "MISMATCH"
+    return {
+        "pallas_check": status,
+        "pallas_interpret": not on_tpu,
+        "pallas_fwd_max_err": round(fwd_err, 5),
+        "pallas_bwd_max_err": round(bwd_err, 5),
+    }
+
 
 def main():
-    import jax
+    if os.environ.get("BENCH_CHILD") != "1":
+        return orchestrate()
+    # ---- measurement child: the only process that imports jax ----
+    try:
+        import jax
+
+        jax.devices()  # force backend init inside the bounded child
+        return run_bench(jax, os.environ.get("BENCH_TPU_ERROR") or None)
+    except Exception as e:  # one parseable line, never a bare stack trace
+        import traceback
+
+        _emit(_error_payload(
+            f"{type(e).__name__}: {e}",
+            traceback=traceback.format_exc()[-1500:],
+        ))
+        return 0
+
+
+def run_bench(jax, init_error):
+    import dataclasses
+
     import jax.numpy as jnp
 
     from nanorlhf_tpu.core import ModelConfig, init_params
@@ -32,25 +217,43 @@ def main():
     from nanorlhf_tpu.parallel import MeshConfig
     from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
 
+    backend = jax.default_backend()
+    on_cpu_fallback = os.environ.get("BENCH_CPU_FALLBACK") == "1"
+
     n_prompts = int(os.environ.get("BENCH_PROMPTS", 32))
     sample_n = int(os.environ.get("BENCH_SAMPLE_N", 4))
     response_len = int(os.environ.get("BENCH_RESPONSE", 256))
-    model_name = os.environ.get("BENCH_MODEL", "1_5b")
+    model_name = os.environ.get(
+        "BENCH_MODEL", "tiny" if on_cpu_fallback else "1_5b"
+    )
     n_updates = int(os.environ.get("BENCH_UPDATES", 2))
     attention_impl = os.environ.get("BENCH_ATTENTION", "xla")
     use_lora = os.environ.get("BENCH_LORA", "1") == "1"
-
-    import dataclasses
+    if on_cpu_fallback:
+        # reduced shapes so the fallback terminates; payload marks backend=cpu
+        n_prompts = min(n_prompts, 8)
+        response_len = min(response_len, 64)
 
     n_dev = len(jax.devices())
+    device_kind = jax.devices()[0].device_kind
+    peak = CPU_PEAK_FLOPS
+    peak_known = False
+    if backend == "tpu":
+        for k, v in PEAK_FLOPS.items():
+            if k in device_kind.lower().replace(" ", ""):
+                peak, peak_known = v, True
+                break
+        if not peak_known:
+            peak = PEAK_FLOPS["v5"]
+
     mcfg = (
         ModelConfig.qwen2_1_5b() if model_name == "1_5b"
         else ModelConfig.qwen2_tiny(vocab_size=4096)
     )
     mcfg = dataclasses.replace(mcfg, attention_impl=attention_impl)
-    dtype = jnp.bfloat16
     tok = ToyTokenizer(vocab_size=min(4096, mcfg.vocab_size))
-    params = init_params(mcfg, jax.random.PRNGKey(0), dtype)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    n_params = count_params({k: v for k, v in params.items() if k != "lora"})
 
     # batch hierarchy: one update consumes n_prompts episodes
     grad_accum = 2 if n_prompts % (2 * 2 * n_dev) == 0 else 1
@@ -92,10 +295,13 @@ def main():
 
     # run update-by-update so compile time (first update) is excluded
     times = []
-    for _ in range(n_updates + 1):
+    phase_snapshot = {}
+    for i in range(n_updates + 1):
         t0 = time.time()
         trainer.train(num_updates=1)
         times.append(time.time() - t0)
+        if i == 0:  # snapshot after warmup so phase split is steady-state only
+            phase_snapshot = dict(trainer.timer.cumulative)
 
     steady = times[1:] if len(times) > 1 else times
     sec_per_update = float(np.mean(steady))
@@ -104,24 +310,84 @@ def main():
     episodes_per_update = cfg.batch_size
     eps_per_sec_per_chip = episodes_per_update / sec_per_update / n_dev
 
-    baseline_eps_per_sec = 1.0  # reference: ~1 s/episode on one A100 40G
-    print(json.dumps({
+    # ---- tokens/s + MFU (napkin model-FLOPs accounting) -------------------
+    # decode runs until every row hits EOS; with a toy-tokenizer reward the
+    # loop nearly always runs the full response_length — use it as the step
+    # count. Rollout processes B·n rows per decode step.
+    rollout_rows = episodes_per_update * sample_n
+    ctx = min(64, dataset.input_ids.shape[1])
+    seq_len = ctx + response_len
+    decode_tokens = rollout_rows * response_len
+    prefill_tokens = rollout_rows * ctx
+    score_tokens = 2 * rollout_rows * seq_len          # policy + ref pass
+    train_tokens = cfg.num_ppo_epochs * episodes_per_update * seq_len
+    fwd = 2.0 * n_params                                # FLOPs per token fwd
+    flops_per_update = (
+        (decode_tokens + prefill_tokens) * fwd
+        + score_tokens * fwd
+        + train_tokens * 3.0 * fwd                      # fwd + bwd ≈ 3× fwd
+    )
+    mfu = flops_per_update / sec_per_update / (peak * n_dev)
+    tokens_per_sec = (
+        (decode_tokens + prefill_tokens + score_tokens + train_tokens)
+        / sec_per_update
+    )
+
+    # steady-state per-update phase split: cumulative minus the warmup
+    # (compile) update, averaged over the timed updates only
+    phase_split = {
+        k: round((v - phase_snapshot.get(k, 0.0)) / max(len(steady), 1), 3)
+        for k, v in sorted(trainer.timer.cumulative.items())
+    }
+
+    pallas = pallas_on_chip_check(jax)
+
+    detail = {
+        "backend": backend,
+        "device_kind": device_kind,
+        "model": model_name,
+        "n_params": n_params,
+        "attention": attention_impl,
+        "lora": use_lora,
+        "prompts_per_update": episodes_per_update,
+        "sample_n": sample_n,
+        "response_length": response_len,
+        "devices": n_dev,
+        "sec_per_update_steady": round(sec_per_update, 3),
+        "compile_update_sec": round(times[0], 3),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "decode_tokens_per_sec": round(decode_tokens / sec_per_update, 1),
+        "mfu": round(mfu, 4),
+        "peak_flops_per_chip": peak,
+        "peak_flops_known": peak_known,
+        "phase_split_s_per_update": phase_split,
+        **pallas,
+    }
+    if init_error is not None:
+        detail["tpu_init_error"] = init_error[-500:]
+
+    # vs_baseline only means something for the flagship model on real TPU
+    # silicon; a tiny-model CPU-fallback number must not claim a beat
+    comparable = backend == "tpu" and model_name == "1_5b"
+    payload = {
         "metric": "grpo_episodes_per_sec_per_chip",
         "value": round(eps_per_sec_per_chip, 4),
         "unit": "episodes/s/chip",
-        "vs_baseline": round(eps_per_sec_per_chip / baseline_eps_per_sec, 4),
-        "detail": {
-            "model": model_name,
-            "attention": attention_impl,
-            "lora": use_lora,
-            "prompts_per_update": episodes_per_update,
-            "sample_n": sample_n,
-            "response_length": response_len,
-            "devices": n_dev,
-            "sec_per_update_steady": round(sec_per_update, 3),
-            "compile_update_sec": round(times[0], 3),
-        },
-    }))
+        "vs_baseline": (
+            round(eps_per_sec_per_chip / BASELINE_EPS_PER_SEC, 4)
+            if comparable else 0.0
+        ),
+        "detail": detail,
+    }
+    if not comparable:
+        detail["vs_baseline_note"] = (
+            "0.0: run not comparable to the A100 baseline "
+            f"(backend={backend}, model={model_name})"
+        )
+    if init_error is not None:
+        payload["error"] = f"TPU unavailable, CPU fallback: {init_error[-300:]}"
+    _emit(payload)
+    return 0
 
 
 if __name__ == "__main__":

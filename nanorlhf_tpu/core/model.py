@@ -155,7 +155,8 @@ def _proj(h, layer_params, lora_layer, name, lora_scale):
 
 
 def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
-                cache_index, lora_layer=None, lora_scale=1.0, attn_fn=None):
+                cache_index, lora_layer=None, lora_scale=1.0, attn_fn=None,
+                decode_bounds=None):
     """One decoder layer. If kv_cache is not None, operate incrementally.
 
     Returns (x_out, new_kv_pair_or_None).
@@ -193,6 +194,14 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             # T_max-padded cache
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
                                 mask_is_causal_x_keyvalid=True)
+        elif T == 1 and config.attention_impl == "pallas" and decode_bounds is not None:
+            # decode: prefix-bounded Pallas kernel reads only the filled
+            # cache range instead of the masked T_max square
+            from nanorlhf_tpu.ops.decode_attention import decode_attention
+
+            start, filled = decode_bounds
+            out = decode_attention(q[:, :, 0, :], k_cache, v_cache,
+                                   start, filled)[:, :, None, :]
         else:
             out = gqa_attention(q, k_cache, v_cache, mask)
     else:
@@ -215,7 +224,8 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
 
 
 def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
-                lora_scale=1.0, remat=False, attn_fn=None, layer_transform=None):
+                lora_scale=1.0, remat=False, attn_fn=None, layer_transform=None,
+                decode_bounds=None):
     """Scan the stacked layer params over the layer body.
 
     `remat=True` wraps the body in jax.checkpoint — the training path's
@@ -248,6 +258,7 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
             y, new_cache = _layer_body(
                 config, carry, layer_params, cos, sin, mask, (k_cache, v_cache),
                 cache_index, lora_layer, lora_scale,
+                decode_bounds=decode_bounds,
             )
             return y, new_cache
 
@@ -452,9 +463,14 @@ def decode_step(
     x = params["embed_tokens"][token][:, None, :].astype(params["embed_tokens"].dtype)
     cos, sin = rope_tables(position[:, None], config.actual_head_dim, config.rope_theta)
     mask = key_mask[:, None, None, :]  # [B, 1, 1, T_max]
+    # valid cache slots form the contiguous range [start, cache_index+1):
+    # left-pad offset up to the slot just written (sampler sets it True before
+    # the call) — the bounds the prefix-reading Pallas decode kernel needs
+    start = jnp.argmax(key_mask, axis=1).astype(jnp.int32)
+    filled = jnp.full((B,), cache_index + 1, jnp.int32)
     x, new_caches = _run_layers(
         config, params, x, cos, sin, mask, kv_caches=kv_caches, cache_index=cache_index,
-        lora_scale=lora_scale,
+        lora_scale=lora_scale, decode_bounds=(start, filled),
     )
     logits = _logits(config, params, x)[:, 0, :]
     return logits, new_caches

@@ -30,7 +30,11 @@ TEMPLATE = (
 )
 
 
-def build_config() -> RLConfig:
+def build_config(sequence_parallel: int = 1) -> RLConfig:
+    """`sequence_parallel > 1` shards the 8k-token scoring/update passes over
+    an sp mesh axis (ring attention, `parallel/sp.py`) — context beyond one
+    chip's HBM. Devices split as (data = n/sp, sp); response_length must be
+    a multiple of sp."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-r1-v0",
@@ -52,6 +56,10 @@ def build_config() -> RLConfig:
         save_steps=1,
         save_total_limit=8,
     )
+    if sequence_parallel > 1:
+        from nanorlhf_tpu.parallel import MeshConfig
+
+        cfg.mesh = MeshConfig(data=-1, sp=sequence_parallel)
     return cfg
 
 

@@ -12,6 +12,9 @@ def build_raft_config():
     cfg.exp_name = "raft-v1"
     cfg.output_dir = "output/raft-v1"
     cfg.sample_n = 4          # raft_sample_K (`RAFT/raft.py:105`)
+    # "best" = documented intent; set "random" for bit-parity with the
+    # reference as shipped (`RAFT/raft_trainer.py:585-588`)
+    cfg.raft_selection = "best"
     return cfg
 
 

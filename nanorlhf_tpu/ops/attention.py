@@ -450,9 +450,19 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
 ) -> jnp.ndarray:
-    """Blockwise flash attention; pads T up to a block multiple internally."""
+    """Blockwise flash attention; pads T up to a block multiple internally.
+
+    Blocks are always multiples of 128 (lane width): a non-aligned T (e.g.
+    100) pads UP to 128 rather than shrinking the block to a lane-unaligned
+    size that Mosaic tiling may reject on real hardware (ADVICE r1). The
+    key_valid padding neutralizes the extra columns; extra query rows are
+    garbage the caller's masking discards.
+    """
     B, H, T, d = q.shape
-    block = min(max(block_q, block_k), max(8 * ((T + 7) // 8), 8))
+    block = max(block_q, block_k)
+    block = max(128, (block // 128) * 128)
+    # never use a block larger than the padded sequence itself
+    block = min(block, 128 * int(pl.cdiv(T, 128)))
     block_q = block_k = block
     T_pad = int(pl.cdiv(T, block) * block)
     if T_pad != T:

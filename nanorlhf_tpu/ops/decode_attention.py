@@ -1,0 +1,171 @@
+"""Pallas decode-attention kernel: read only the FILLED cache prefix.
+
+The round-1 decode step attended over the whole [B, KV, T_max, hd] cache
+with masking every token (`core/model.py` decode path) — at 8k-token
+responses that reads the full cache square-wise over the rollout while the
+valid region grows linearly. This kernel is the TPU-native analogue of
+vLLM's paged/decode attention (SURVEY.md §2.2 row 1, replacing the CUDA
+kernels behind `/root/reference/GRPO/grpo_trainer.py:122-166`):
+
+- **Scalar-prefetched bounds**: per-row `start` (left-pad offset) and
+  `filled` (one past the last written slot) arrive as scalar-prefetch
+  operands, so the KV BlockSpec index_map can CLAMP the block index to the
+  valid range. Grid steps past the last valid block re-map to the same
+  block; Pallas's revisiting optimization skips the re-fetch, so HBM traffic
+  is proportional to the filled prefix, not T_max.
+- **Online softmax** across kv blocks (same recipe as `ops/attention.py`),
+  carried in VMEM scratch.
+- **GQA layout**: queries are grouped [B, KV, G, hd] and each (batch, kv
+  head) grid cell contracts its G query heads against one un-repeated KV
+  block — no KV repeat materialization, identical to the train-time kernel.
+
+Decode attention is HBM-bandwidth-bound (the MXU sees [G, block] matmuls);
+the win is skipped traffic, not FLOPs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from nanorlhf_tpu.ops.attention import _interpret_default
+
+try:  # pragma: no cover - pltpu import guarded like ops/attention.py
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def reference_decode_attention(q, k_cache, v_cache, start, filled):
+    """XLA oracle: masked softmax over the cache. q: [B, H, hd];
+    k/v: [B, KV, T, hd]; start/filled: [B] int32. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    KV, T = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    qg = q.reshape(B, KV, G, hd)
+    s = jnp.einsum("bkgh,bkth->bkgt", qg, k_cache).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(hd))
+    pos = jnp.arange(T)[None, :]
+    valid = (pos >= start[:, None]) & (pos < filled[:, None])  # [B, T]
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgt,bkth->bkgh", p, v_cache)
+    return out.reshape(B, H, hd)
+
+
+def _decode_kernel(start_ref, filled_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float, block_k: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_blk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+    filled = filled_ref[b]
+    first_blk = start // block_k
+    last_blk = (filled - 1) // block_k
+    actual_j = jnp.minimum(first_blk + j, last_blk)
+
+    # grid steps beyond the valid range re-visit last_blk with compute skipped
+    @pl.when(first_blk + j <= last_blk)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [Gp, hd]
+        k = k_ref[0, 0].astype(jnp.float32)              # [block_k, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [Gp, block_k]
+        pos = actual_j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where((pos >= start) & (pos < filled), s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_blk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,        # [B, H, hd] — single decode position
+    k_cache: jnp.ndarray,  # [B, KV, T_max, hd]
+    v_cache: jnp.ndarray,  # [B, KV, T_max, hd]
+    start: jnp.ndarray,    # [B] int32: first valid cache slot (left-pad offset)
+    filled: jnp.ndarray,   # [B] int32: one past the last valid slot
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Prefix-bounded decode attention. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    KV, T = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    Gp = max(8, G)  # sublane-pad the tiny query-head dim
+    block_k = min(block_k, max(128, 128 * pl.cdiv(T, 128)))
+
+    qg = q.reshape(B, KV, G, hd)
+    if Gp != G:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Gp - G), (0, 0)])
+
+    if T % block_k != 0:
+        pad_t = block_k * pl.cdiv(T, block_k) - T
+        padz = [(0, 0), (0, 0), (0, pad_t), (0, 0)]
+        k_cache = jnp.pad(k_cache, padz)
+        v_cache = jnp.pad(v_cache, padz)
+        T = T + pad_t
+    n_blk = T // block_k
+
+    scale = 1.0 / (hd ** 0.5)
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=block_k)
+
+    def kv_index_map(b, kv, j, start_ref, filled_ref):
+        first = start_ref[b] // block_k
+        last = (filled_ref[b] - 1) // block_k
+        return (b, kv, jnp.minimum(first + j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, n_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Gp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Gp, hd), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Gp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), filled.astype(jnp.int32), qg, k_cache, v_cache)
+    return out[:, :, :G, :].reshape(B, H, hd)

@@ -10,6 +10,9 @@ grpo_trainer.py:168-172,475-476,622-626`). Here the same capability is a
 - `fsdp`  — parameter/optimizer-state sharding (ZeRO-equivalent; replaces the
             optimizer-state CPU paging entirely)
 - `tensor`— megatron-style tensor parallel for >8B models
+- `sp`    — sequence/context parallel (ring attention over ICI;
+            `parallel/sp.py`). Params and batch are replicated over sp; the
+            sequence dim of the scoring/update passes shards over it.
 
 All rules are GSPMD PartitionSpecs over the *stacked* param tree of
 core/model.py; XLA inserts the collectives (psum/all-gather over ICI).
@@ -31,24 +34,25 @@ class MeshConfig:
     data: int = -1      # -1 = all remaining devices
     fsdp: int = 1
     tensor: int = 1
+    sp: int = 1         # sequence-parallel extent (ring attention)
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int]:
-        d, f, t = self.data, self.fsdp, self.tensor
-        known = (f if f > 0 else 1) * (t if t > 0 else 1)
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        d, f, t, s = self.data, self.fsdp, self.tensor, self.sp
+        known = (f if f > 0 else 1) * (t if t > 0 else 1) * (s if s > 0 else 1)
         if d == -1:
             d = n_devices // known
-        if d * f * t != n_devices:
+        if d * f * t * s != n_devices:
             raise ValueError(
-                f"mesh {d}x{f}x{t} != {n_devices} devices"
+                f"mesh {d}x{f}x{t}x{s} != {n_devices} devices"
             )
-        return d, f, t
+        return d, f, t, s
 
 
 def make_mesh(config: MeshConfig = MeshConfig(), devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
-    d, f, t = config.resolve(len(devices))
-    arr = np.asarray(devices).reshape(d, f, t)
-    return Mesh(arr, ("data", "fsdp", "tensor"))
+    d, f, t, s = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(d, f, t, s)
+    return Mesh(arr, ("data", "fsdp", "tensor", "sp"))
 
 
 # ---------------------------------------------------------------------------

@@ -18,7 +18,9 @@ compiled graph.
 
 from __future__ import annotations
 
+import logging
 import multiprocessing
+import os
 import re
 
 # ---------------------------------------------------------------------------
@@ -243,28 +245,84 @@ def _ensure_sympy_loaded():
     import sympy.parsing.sympy_parser  # noqa: F401
 
 
+_logger = logging.getLogger("nanorlhf_tpu.rewards")
+_GRADER_CTX = None
+
+
+def _grader_context():
+    """Grader subprocess context, created once.
+
+    Default: `fork` with the parent's preloaded sympy — fast (<1 ms spawn)
+    but forks the (threaded) JAX parent; a wedged child is bounded by
+    join+terminate and LOGGED (see call_with_timeout), so silent reward
+    corruption is observable (ADVICE r1).
+
+    `NANORLHF_GRADER_START_METHOD=forkserver` opts into forking from a
+    single-threaded server instead — eliminates the fork-under-threads
+    deadlock class entirely, at the price of spawn start-method semantics:
+    children re-import `__main__` (grading funcs defined in a REPL/stdin
+    fail, and launcher modules must be import-safe) and each child pays the
+    server round-trip.
+    """
+    global _GRADER_CTX
+    if _GRADER_CTX is None:
+        method = os.environ.get("NANORLHF_GRADER_START_METHOD", "fork")
+        if method == "forkserver":
+            ctx = multiprocessing.get_context("forkserver")
+            ctx.set_forkserver_preload(
+                ["sympy", "sympy.parsing.sympy_parser",
+                 "nanorlhf_tpu.rewards.math_grader"]
+            )
+            _GRADER_CTX = ctx
+        else:
+            _ensure_sympy_loaded()
+            _GRADER_CTX = multiprocessing.get_context("fork")
+    return _GRADER_CTX
+
+
 def call_with_timeout(func, *args, timeout: float = 0.5):
-    """Run func(*args, queue) in a forked subprocess; False on timeout or
-    exception.
+    """Run func(*args, queue) in a subprocess; False on timeout or exception.
 
     Same contract as the reference's guard (`grpo_r1.py:179-192`): the child
     receives an extra Queue argument and must put its result there. join +
-    terminate bounds the wait even if the fork deadlocks under a threaded
-    parent.
+    terminate bounds the wait even if the child wedges. Every
+    timeout/terminate/no-result path is LOGGED — a graded-False caused by
+    infrastructure rather than a wrong answer must be observable, since it
+    corrupts the reward signal silently otherwise.
     """
-    _ensure_sympy_loaded()
-    ctx = multiprocessing.get_context("fork")
+    global _GRADER_CTX
+    ctx = _grader_context()
     q = ctx.Queue()
-    p = ctx.Process(target=func, args=args + (q,))
-    p.start()
+    try:
+        p = ctx.Process(target=func, args=args + (q,))
+        p.start()
+    except Exception as e:
+        # e.g. unpicklable func under forkserver: fall back to plain fork
+        # PERSISTENTLY — re-attempting a doomed forkserver spawn on every one
+        # of thousands of per-rollout grades would pay the failure each time
+        _logger.warning("grader forkserver spawn failed (%s); using fork", e)
+        _ensure_sympy_loaded()
+        ctx = multiprocessing.get_context("fork")
+        _GRADER_CTX = ctx
+        q = ctx.Queue()
+        p = ctx.Process(target=func, args=args + (q,))
+        p.start()
     p.join(timeout)
     if p.is_alive():
         p.terminate()
         p.join()
+        _logger.warning(
+            "grader timed out after %.3fs — graded False (func=%s)",
+            timeout, getattr(func, "__name__", func),
+        )
         return False
     try:
         return q.get(timeout=0.1)
     except Exception:
+        _logger.warning(
+            "grader child exited without a result (rc=%s) — graded False",
+            p.exitcode,
+        )
         return False
 
 

@@ -1,16 +1,25 @@
-"""Sandboxed execution of model-emitted Python — program-of-thought grading.
+"""Isolated-subprocess execution of model-emitted Python — program-of-thought
+grading.
 
 Capability parity with the vendored Qwen eval toolkit's `PythonExecutor`
 (`/root/reference/examples/r1-v0/utils/eval/python_executor.py:42`): run a
 code snippet in a killable subprocess with a wall-clock timeout, capture the
-value of an `answer` variable (or stdout), never let model code touch the
+value of an `answer` variable (or stdout), never let model code crash the
 training process. Host-side only.
+
+Containment = process isolation + wall-clock timeout + child resource limits
+(CPU seconds, address space, file size) + a scratch working directory. This
+is NOT a security sandbox: the child still has host filesystem/network
+access with the parent's credentials (same as the reference toolkit) — run
+untrusted-model graders inside a containerized host if that matters.
 """
 
 from __future__ import annotations
 
 import multiprocessing
+import os
 import sys
+import tempfile
 import traceback
 from dataclasses import dataclass
 from io import StringIO
@@ -24,13 +33,41 @@ class ExecutionResult:
     error: str = ""
 
 
-def _exec_worker(code: str, answer_expr: str | None, q):
+def _apply_child_limits(cpu_seconds: int, mem_bytes: int | None):
+    """Best-effort rlimits in the exec child: bound CPU burn and accidental
+    giant file writes. Failures are ignored — limits are hardening, not the
+    containment boundary.
+
+    RLIMIT_AS is OPT-IN (`mem_bytes`): the child forks from the training
+    process, whose mapped virtual address space (JAX/TPU runtime) routinely
+    exceeds any sane fixed cap — a default AS limit below the inherited
+    mappings would fail every snippet with MemoryError.
+    """
+    try:
+        import resource
+
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu_seconds, cpu_seconds + 1))
+        if mem_bytes is not None:
+            resource.setrlimit(resource.RLIMIT_AS, (mem_bytes, mem_bytes))
+        resource.setrlimit(resource.RLIMIT_FSIZE, (64 * 1024**2, 64 * 1024**2))
+    except Exception:
+        pass
+    try:
+        scratch = tempfile.mkdtemp(prefix="nanorlhf_exec_")
+        os.chdir(scratch)
+    except Exception:
+        pass
+
+
+def _exec_worker(code: str, answer_expr: str | None, q,
+                 cpu_seconds: int = 10, mem_bytes: int | None = None):
+    _apply_child_limits(cpu_seconds, mem_bytes)
     buf = StringIO()
     old_stdout = sys.stdout
     sys.stdout = buf
     try:
         glb: dict = {"__name__": "__main__"}
-        exec(code, glb)  # noqa: S102 — sandboxed by subprocess + timeout
+        exec(code, glb)  # noqa: S102 — isolated subprocess + timeout + rlimits
         answer = ""
         if answer_expr:
             try:
@@ -49,14 +86,20 @@ def _exec_worker(code: str, answer_expr: str | None, q):
 class PythonExecutor:
     """`run(code)` → ExecutionResult; `timeout` seconds per snippet."""
 
-    def __init__(self, timeout: float = 5.0, answer_expr: str | None = None):
+    def __init__(self, timeout: float = 5.0, answer_expr: str | None = None,
+                 cpu_seconds: int = 10, mem_bytes: int | None = None):
         self.timeout = timeout
         self.answer_expr = answer_expr
+        self.cpu_seconds = cpu_seconds
+        self.mem_bytes = mem_bytes
 
     def run(self, code: str) -> ExecutionResult:
         ctx = multiprocessing.get_context("fork")
         q = ctx.Queue()
-        p = ctx.Process(target=_exec_worker, args=(code, self.answer_expr, q))
+        p = ctx.Process(
+            target=_exec_worker,
+            args=(code, self.answer_expr, q, self.cpu_seconds, self.mem_bytes),
+        )
         p.start()
         p.join(self.timeout)
         if p.is_alive():

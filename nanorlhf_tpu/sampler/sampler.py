@@ -39,10 +39,24 @@ class SamplingParams:
     n: int = 1
     max_tokens: int = 256
     greedy: bool = False
+    # top-k pre-trim for nucleus sampling: the per-step full-vocab sort (the
+    # round-1 decode hot spot at 152k vocab) becomes one lax.top_k + a
+    # k-sized categorical. Exact nucleus sampling whenever the 0.95-nucleus
+    # fits in the top-k — true for trained models at production temperatures;
+    # NOT true for random-init/high-entropy policies, where this truncates
+    # the tail to the k best tokens (the combined top-k/top-p semantics vLLM
+    # exposes as `SamplingParams(top_k=...)`). Set top_k=0 to disable the
+    # pre-trim and recover the exact full-vocab nucleus at full-sort cost.
+    # Ignored when top_p >= 1.0 (that path is always exact full-vocab).
+    top_k: int = 64
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
-    """Mask logits outside the top-p nucleus (smallest set with cum prob ≥ p)."""
+    """Mask logits outside the top-p nucleus (smallest set with cum prob ≥ p).
+
+    Full-vocab exact variant — kept as the reference/oracle for the fused
+    top-k path used in the decode loop.
+    """
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(sorted_probs, axis=-1)
@@ -55,19 +69,43 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return jnp.where(logits >= threshold, logits, -jnp.inf)
 
 
-def _sample_token(key, logits, temperature, top_p, greedy):
+def _sample_token(key, logits, temperature, top_p, greedy, top_k=64):
+    """Sample one token per row.
+
+    `top_p >= 1.0` (no nucleus requested) stays an EXACT full-vocab
+    categorical — truncating to top-k there would silently bias the sampling
+    distribution away from the full-vocab logprobs the RL ratio math scores
+    against. The nucleus path never sorts or draws Gumbel noise over the
+    full vocabulary: candidates come from `lax.top_k`, the nucleus rule is
+    applied over their TRUE probabilities (normalized by a full-vocab
+    logsumexp, so the keep set matches the exact filter), and the
+    categorical runs in k-space with indices mapped back through the top-k
+    gather.
+    """
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
-    if top_p < 1.0:
-        logits = top_p_filter(logits, top_p)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    if top_p >= 1.0 or top_k <= 0:
+        if top_p < 1.0:
+            logits = top_p_filter(logits, top_p)   # exact full-vocab nucleus
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    k = min(top_k, logits.shape[-1])
+    top_logits, top_idx = jax.lax.top_k(logits, k)      # descending
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    probs = jnp.exp(top_logits - lse)                   # true (unrenormalized) probs
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p                        # exclusive-cum; first always kept
+    top_logits = jnp.where(keep, top_logits, -jnp.inf)
+    choice = jax.random.categorical(key, top_logits, axis=-1)
+    return jnp.take_along_axis(
+        top_idx, choice[..., None], axis=-1
+    )[..., 0].astype(jnp.int32)
 
 
 @partial(
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
-                     "temperature", "top_p", "greedy", "lora_scale"),
+                     "temperature", "top_p", "greedy", "lora_scale", "top_k"),
 )
 def generate_tokens(
     params: dict,
@@ -83,6 +121,7 @@ def generate_tokens(
     top_p: float = 0.95,
     greedy: bool = False,
     lora_scale: float = 1.0,
+    top_k: int = 64,
 ) -> jnp.ndarray:
     """Core jitted loop: one sample per row. Returns [B, max_tokens] int32."""
     B, Tp = prompt_ids.shape
@@ -99,7 +138,7 @@ def generate_tokens(
 
     out0 = jnp.full((B, max_tokens), pad_token_id, jnp.int32)
     key, k0 = jax.random.split(key)
-    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy)
+    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy, top_k)
     out0 = out0.at[:, 0].set(tok0)
     done0 = tok0 == eos_token_id
 
@@ -119,7 +158,7 @@ def generate_tokens(
             lora_scale=lora_scale,
         )
         key, k = jax.random.split(key)
-        tok = _sample_token(k, logits, temperature, top_p, greedy)
+        tok = _sample_token(k, logits, temperature, top_p, greedy, top_k)
         tok = jnp.where(done, pad_token_id, tok)
         out = jnp.where(
             (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None], tok[:, None], out
@@ -160,4 +199,5 @@ def generate(
         top_p=sampling.top_p,
         greedy=sampling.greedy,
         lora_scale=lora_scale,
+        top_k=sampling.top_k,
     )

@@ -85,6 +85,11 @@ class RLConfig:
     lam: float = 0.95
     whiten_rewards: bool = False
     advantage_whiten: bool = False       # REINFORCE defaults True in its launcher
+    # RAFT 1-of-K selection: "best" = argmax (the reference's documented
+    # intent, `RAFT/raft_trainer.py:585-586`), "random" = the as-shipped
+    # behavior where a torch.randint overwrites the argmax (`:588`) — exposed
+    # as config so bit-parity runs need no code change (ADVICE r1)
+    raft_selection: str = "best"
 
     # ---- LoRA ----
     use_lora: bool = True
@@ -122,10 +127,16 @@ class RLConfig:
     num_total_batches: int = dataclasses.field(default=0, init=False)
 
     def finalize(self, n_devices: int) -> "RLConfig":
+        """Derive the batch hierarchy from `self.mesh` over n_devices."""
+        d, f, t, _sp = self.mesh.resolve(n_devices)
+        return self.finalize_world(d * f)
+
+    def finalize_world(self, world_size: int) -> "RLConfig":
         """Derive the batch hierarchy. `world_size` = data-parallel extent of
-        the mesh (data × fsdp axes — both shard the batch)."""
-        d, f, t = self.mesh.resolve(n_devices)
-        self.world_size = d * f
+        the mesh (data × fsdp axes — both shard the batch). Preferred over
+        finalize() when an explicit Mesh exists: its axis extents are the
+        truth, not self.mesh's (an externally built mesh may differ)."""
+        self.world_size = world_size
         self.local_batch_size = (
             self.per_device_train_batch_size
             * self.gradient_accumulation_steps

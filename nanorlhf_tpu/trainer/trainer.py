@@ -57,6 +57,7 @@ from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params, trainable_mask
 from nanorlhf_tpu.core.model import padded_forward_logits, score_forward
 from nanorlhf_tpu.ops.masking import (
     INVALID_LOGPROB,
+    entropy_from_logits,
     first_true_indices,
     logprobs_from_logits,
     masked_whiten,
@@ -84,13 +85,19 @@ def forward_token_budget(vocab_size: int, bytes_per_elem: int = 2) -> int:
     return min(ACTIVATION_TOKEN_BUDGET, vocab_cap)
 
 
-def pick_chunk_size(total: int, desired: int) -> int:
-    """Largest divisor of `total` that is ≤ the desired chunk size."""
-    desired = max(1, min(total, desired))
-    for c in range(desired, 0, -1):
-        if total % c == 0:
-            return c
-    return 1
+def pad_chunk(rows: np.ndarray, chunk: int) -> np.ndarray:
+    """Pad a short final chunk up to `chunk` rows by repeating the last row.
+
+    Chunked jitted passes run at ONE fixed shape: a ragged tail (e.g. a prime
+    rollout count) is padded instead of shrinking the chunk — the old
+    largest-divisor search silently degenerated to chunk=1 on awkward totals.
+    Callers slice results back to the real row count.
+    """
+    n = rows.shape[0]
+    if n >= chunk:
+        return rows
+    reps = np.repeat(rows[-1:], chunk - n, axis=0)
+    return np.concatenate([rows, reps], axis=0)
 
 
 class RLTrainer:
@@ -128,7 +135,9 @@ class RLTrainer:
                     "PromptDataset) to derive episodes from num_train_epochs"
                 )
             config.total_episodes = int(config.num_train_epochs * len(dataset))
-        config.finalize(self.mesh.devices.size)
+        config.finalize_world(
+            self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
+        )
 
         self.key = rng_key if rng_key is not None else jax.random.PRNGKey(config.seed)
 
@@ -183,7 +192,10 @@ class RLTrainer:
 
         self.timer = PhaseTimer()
         self._update_fn = self._make_update_fn()
-        self.state = {"episode": 0, "global_step": 0}
+        # opt_steps counts ACTUAL optimizer.update calls — the schedule index
+        # for the `lr` metric (a derived formula drifts when the minibatch
+        # loop doesn't divide evenly)
+        self.state = {"episode": 0, "global_step": 0, "opt_steps": 0}
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -247,6 +259,11 @@ class RLTrainer:
         # separate policy/value LR groups (`PPO/ppo_trainer.py:341-402`);
         # operates on the trainable-only partition, so no freeze transform
         value_lr = cfg.value_learning_rate or cfg.learning_rate
+        # the schedule fns are kept for the `lr` metric (the reference logs
+        # `lr_scheduler.get_last_lr()`, `GRPO/grpo_trainer.py:744`)
+        self._lr_schedules = {
+            "policy": sched(cfg.learning_rate), "value": sched(value_lr)
+        }
         return optax.multi_transform(
             {"policy": adamw(cfg.learning_rate), "value": adamw(value_lr)},
             param_labels=lambda tree: {
@@ -276,6 +293,12 @@ class RLTrainer:
                 lora_scale=lora_scale, remat=remat,
                 response_context_length=context_length,
             )
+            # true update-pass entropy over the temperature-scaled logits —
+            # `policy/entropy_avg_new`, unmasked mean like the reference
+            # (`GRPO/grpo_trainer.py:679-687`)
+            entropy = jax.lax.stop_gradient(entropy_from_logits(
+                logits.astype(jnp.float32) / (cfg.temperature + 1e-7)
+            ).mean())
             new_logprobs = logprobs_from_logits(
                 logits, mb["responses"], cfg.temperature
             )
@@ -317,6 +340,7 @@ class RLTrainer:
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
                 )
+            aux["entropy"] = entropy
             return loss, aux
 
         mesh = self.mesh
@@ -519,16 +543,18 @@ class RLTrainer:
                 forward_token_budget(self.mcfg.vocab_size)
                 // (context_length + cfg.response_length),
             )
-            chunk = pick_chunk_size(total, chunk)
+            chunk = max(1, min(total, chunk))
             logprobs_l, ref_logprobs_l = [], []
             with self.timer.phase("logprob"):
                 for i in range(0, total, chunk):
+                    n_real = min(chunk, total - i)
                     lp, rlp = score_fn(
                         self.params, self.ref_params,
-                        jnp.asarray(qr[i : i + chunk]), context_length,
+                        jnp.asarray(pad_chunk(qr[i : i + chunk], chunk)),
+                        context_length,
                     )
-                    logprobs_l.append(np.asarray(lp))
-                    ref_logprobs_l.append(np.asarray(rlp))
+                    logprobs_l.append(np.asarray(lp)[:n_real])
+                    ref_logprobs_l.append(np.asarray(rlp)[:n_real])
             logprobs = np.concatenate(logprobs_l)
             ref_logprobs = np.concatenate(ref_logprobs_l)
 
@@ -553,7 +579,7 @@ class RLTrainer:
                 scores_sel[~contain_eos] -= cfg.missing_eos_penalty
 
             # ---- per-algo advantage assembly ------------------------------
-            batch, keep_inds = self._assemble_batch(
+            batch, keep_inds, reward_info = self._assemble_batch(
                 scores_sel, logprobs, ref_logprobs, padding_mask, padding_mask_p1,
                 seq_lengths, qr, responses_np, context_length, batch_size, n,
             )
@@ -574,6 +600,10 @@ class RLTrainer:
             all_stats = []
             local_bs = batch["responses"].shape[0]
             mini = max(1, local_bs // cfg.num_mini_batches)
+            # lr reported for THIS update = schedule at the step count its
+            # first optimizer.update saw (the reference's get_last_lr-before-
+            # scheduler.step semantics, `grpo_trainer.py:744-750`)
+            lr_step = self.state["opt_steps"]
             with self.timer.phase("update"):
                 for epoch in range(cfg.num_ppo_epochs):
                     self.key, pk = jax.random.split(self.key)
@@ -590,6 +620,7 @@ class RLTrainer:
                         trainable, self.opt_state, stats = self._update_fn(
                             trainable, frozen, self.opt_state, mb, context_length
                         )
+                        self.state["opt_steps"] += 1
                         # keep stats on device; syncing per minibatch would
                         # serialize update dispatch
                         all_stats.append(stats)
@@ -598,8 +629,12 @@ class RLTrainer:
                 self.value_params = train_tree.get("value")
                 all_stats = jax.device_get(all_stats)
 
-            # ---- METRICS ---------------------------------------------------
+            # ---- METRICS (names + semantics per docs/METRICS.md) -----------
             sec_per_episode = (time.time() - t_start) / cfg.batch_size
+            # entropy proxy: summed response negative logprob (the reference's
+            # `(-logprobs).sum(1).mean()`, `GRPO/grpo_trainer.py:710`, with
+            # pad positions masked to 0 instead of contributing the INVALID
+            # sentinel); the true entropy is policy/entropy_avg_new below
             mean_entropy = float(
                 (-np.where(padding_mask, 0.0, logprobs)).sum(1).mean()
             )
@@ -610,19 +645,39 @@ class RLTrainer:
             kl_rollout = float(
                 np.where(padding_mask, 0.0, logprobs - ref_logprobs).sum(1).mean()
             )
+            # GRPO parity: the reference fills kl_old from the UPDATE-pass
+            # new-vs-ref KL stats (`GRPO/grpo_trainer.py:668-670,689,728`);
+            # every KL-in-reward trainer uses the rollout token-sum KL
+            # (`RLOO/rloo_trainer.py:704-706`). kl_rollout_old is always the
+            # honest pre-update measurement.
+            kl_old = (
+                agg.get("refkl_mean", kl_rollout)
+                if self.algo == AlgoName.GRPO else kl_rollout
+            )
             metrics = {
-                "objective/kl_old": agg.get("refkl_mean", kl_rollout),
+                "objective/kl_old": kl_old,
+                "objective/kl_rollout_old": kl_rollout,
                 "objective/entropy_old": mean_entropy,
-                "objective/non_score_reward_old": 0.0,
-                "eval_objective/rlhf_reward_old": float(np.mean(log_scores_all)),
+                "objective/non_score_reward_old": reward_info.get(
+                    "non_score_reward_old", 0.0
+                ),
+                "eval_objective/rlhf_reward_old": reward_info.get(
+                    "rlhf_reward_old", float(np.mean(log_scores_all))
+                ),
                 "eval_objective/scores_old": float(np.mean(log_scores_all)),
                 "policy/approxkl_avg_new": agg.get("approxkl", 0.0),
                 "policy/clipfrac_avg_new": agg.get("pg_clipfrac", 0.0),
+                "policy/entropy_avg_new": agg.get("entropy", 0.0),
                 "loss/policy_avg_new": agg.get("pg_loss", 0.0),
                 "val/ratio_new": agg.get("ratio_mean", 1.0),
+                "val/ratio_var_new": float(np.var(
+                    [s.get("ratio_mean", 1.0) for s in all_stats]
+                )) if all_stats else 0.0,
                 "val/num_eos_tokens_old": float(
                     (np.asarray(postprocessed) == eos_id).sum()
                 ),
+                "lr": float(self._lr_schedules["policy"](lr_step)),
+                "eps": cfg.adam_eps,
                 "sec_per_episode": sec_per_episode,
                 "episode": self.state["episode"],
             }
@@ -646,7 +701,8 @@ class RLTrainer:
                     rng_key=self.key,
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
-                    extra_state={"episode": self.state["episode"]},
+                    extra_state={"episode": self.state["episode"],
+                                 "opt_steps": self.state["opt_steps"]},
                     value_params=self.value_params if cfg.save_value_model else None,
                 )
 
@@ -693,6 +749,7 @@ class RLTrainer:
         tstate = self.ckpt.load_trainer_state(step)
         self.state["global_step"] = tstate["step"]
         self.state["episode"] = tstate.get("episode", 0)
+        self.state["opt_steps"] = tstate.get("opt_steps", 0)
         if "rng_key" in tstate:
             raw = jnp.asarray(np.asarray(tstate["rng_key"], dtype=np.uint32))
             self.key = jax.random.wrap_key_data(raw) if tstate.get("rng_key_typed") else raw
@@ -735,7 +792,9 @@ class RLTrainer:
             adv = np.where(padding_mask, 0.0, adv)
             batch["advantages"] = adv
             batch["ref_logprobs"] = ref_logprobs
-            return batch, None
+            # GRPO keeps KL in-loss: non_score_reward is identically 0, and
+            # the reference hard-codes the metric so (`grpo_trainer.py:730`)
+            return batch, None, {"non_score_reward_old": 0.0}
 
         # KL-in-reward family
         kl_penalty = -cfg.kl_coef * np.where(padding_mask, 0.0, kl)
@@ -748,6 +807,14 @@ class RLTrainer:
                 jnp.asarray(rewards), jnp.asarray(~padding_mask_p1), shift_mean=True
             ))
             rewards = np.where(padding_mask_p1, 0.0, rewards)
+        # the scores-vs-rlhf_reward split for KL-in-reward algorithms
+        # (`RLOO/rloo_trainer.py:704-710`): non_score = the KL penalty alone,
+        # rlhf_reward = the full shaped per-sequence reward, both over ALL
+        # B·N rollouts (before any 1-of-N selection)
+        reward_info = {
+            "non_score_reward_old": float(kl_penalty.sum(1).mean()),
+            "rlhf_reward_old": float(rewards.sum(1).mean()),
+        }
 
         if self.algo == AlgoName.RLOO:
             rlhf_reward = rewards.sum(1)
@@ -763,17 +830,21 @@ class RLTrainer:
                 ))
             batch = {k_: sel(v) for k_, v in batch.items()}
             batch["advantages_seq"] = adv_seq
-            return batch, keep
+            return batch, keep, reward_info
 
         if self.algo == AlgoName.RAFT:
             rlhf_reward = rewards.sum(1)
-            keep = np.asarray(best_of_k_indices(jnp.asarray(rlhf_reward), n))
+            if cfg.raft_selection == "random":
+                self.key, rk = jax.random.split(self.key)
+                keep = np.asarray(best_of_k_indices(jnp.asarray(rlhf_reward), n, key=rk))
+            else:
+                keep = np.asarray(best_of_k_indices(jnp.asarray(rlhf_reward), n))
             rows = np.arange(batch_size)
             batch = {
                 k_: v.reshape(batch_size, n, *v.shape[1:])[rows, keep]
                 for k_, v in batch.items()
             }
-            return batch, keep
+            return batch, keep, reward_info
 
         if self.algo == AlgoName.PPO:
             values = self._value_pass(qr, context_length)
@@ -788,7 +859,7 @@ class RLTrainer:
             batch["advantages"] = adv
             batch["returns"] = np.asarray(returns)
             batch["values"] = values
-            return batch, None
+            return batch, None, reward_info
 
         # REINFORCE / ReMax: γ-discounted reversed cumsum
         adv = np.asarray(discounted_returns(jnp.asarray(rewards), cfg.gamma))
@@ -796,16 +867,14 @@ class RLTrainer:
             adv = np.asarray(masked_whiten(jnp.asarray(adv), jnp.asarray(~padding_mask)))
         adv = np.where(padding_mask, 0.0, adv)
         batch["advantages"] = adv
-        return batch, None
+        return batch, None, reward_info
 
     def _value_pass(self, qr, context_length):
         """Chunked value prediction (`PPO/ppo_trainer.py:630-634`)."""
         total = qr.shape[0]
         # value forward emits [B, T, 1] scores — no vocab-sized logits block —
         # so only the activation-based token budget applies
-        chunk = pick_chunk_size(
-            total, max(1, ACTIVATION_TOKEN_BUDGET // qr.shape[1])
-        )
+        chunk = max(1, min(total, ACTIVATION_TOKEN_BUDGET // qr.shape[1]))
         vals = []
         if not hasattr(self, "_value_fn"):
             from functools import partial
@@ -819,8 +888,10 @@ class RLTrainer:
 
             self._value_fn = value_fn
         for i in range(0, total, chunk):
+            n_real = min(chunk, total - i)
             vals.append(np.asarray(
-                self._value_fn(self.value_params, jnp.asarray(qr[i : i + chunk]),
+                self._value_fn(self.value_params,
+                               jnp.asarray(pad_chunk(qr[i : i + chunk], chunk)),
                                context_length)
-            ))
+            )[:n_real])
         return np.concatenate(vals)

@@ -26,6 +26,9 @@ class PhaseTimer:
     def __init__(self):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        # never reset: whole-run phase split (bench MFU accounting reads this
+        # across updates while the per-update summary() resets each step)
+        self.cumulative: dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -36,8 +39,10 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.totals[name] = self.totals.get(name, 0.0) + (time.time() - t0)
+            dt = time.time() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            self.cumulative[name] = self.cumulative.get(name, 0.0) + dt
 
     def summary(self, reset: bool = True) -> dict:
         out = {f"time/{k}_s": v for k, v in self.totals.items()}

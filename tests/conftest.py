@@ -25,7 +25,33 @@ jax.config.update("jax_enable_x64", False)
 
 # Persistent XLA compilation cache: jit compiles dominate suite wall time on
 # small hosts; repeat runs (CI / driver rounds) reuse executables from disk.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+# The dir is keyed by a host CPU fingerprint: XLA:CPU AOT results compiled on
+# a machine with different vector extensions ABORT (SIGILL) when loaded — a
+# cache carried across driver rounds on heterogeneous hosts did exactly that.
+import hashlib
+
+
+def _host_fingerprint() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            content = f.read()
+        for key in ("flags", "Features"):  # x86 / aarch64 spellings
+            for line in content.splitlines():
+                if line.startswith(key):
+                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+        # unknown layout: hash the whole thing (may over-rotate the cache on
+        # per-boot fields, but never under-distinguishes vector extensions)
+        return hashlib.sha1(content.encode()).hexdigest()[:12]
+    except OSError:
+        import platform
+
+        key = f"{platform.machine()}-{platform.processor()}"
+        return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+
+_cache_dir = os.path.join(
+    os.path.dirname(__file__), "..", f".jax_cache_{_host_fingerprint()}"
+)
 try:
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
     # persist even sub-second compiles: tiny-model suites are made of them

@@ -114,3 +114,40 @@ def test_prefill_logits_match_full_forward(tiny):
     np.testing.assert_allclose(
         np.asarray(last_logits), np.asarray(full[:, -1, :]), rtol=1e-4, atol=1e-4
     )
+
+
+def test_topk_nucleus_matches_exact_filter():
+    """The fused top-k nucleus path samples only tokens inside the EXACT
+    full-vocab nucleus (the keep rule is applied over true probabilities via
+    a full-vocab logsumexp, so whenever the nucleus fits in top-k the two
+    filters agree)."""
+    from nanorlhf_tpu.sampler.sampler import _sample_token, top_p_filter
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 512)) * 3.0  # peaked
+    allowed = np.asarray(top_p_filter(logits, 0.95)) > -np.inf
+    keys = jax.random.split(jax.random.PRNGKey(1), 256)
+    toks = np.asarray(jax.vmap(
+        lambda k: _sample_token(k, logits, 1.0, 0.95, False, 64)
+    )(keys))                                            # [256, 4]
+    for t_row in toks:
+        for b, t in enumerate(t_row):
+            assert allowed[b, t], f"sampled token {t} outside exact nucleus"
+
+
+def test_topk_sampling_distribution_small_vocab():
+    """With top_k == vocab the fused path IS exact nucleus sampling: the
+    empirical distribution over many draws matches the renormalized nucleus
+    probabilities."""
+    from nanorlhf_tpu.sampler.sampler import _sample_token, top_p_filter
+
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0, -8.0, -8.0, -8.0, -8.0]])
+    masked = np.asarray(top_p_filter(logits, 0.9))[0]
+    probs = np.exp(masked - masked.max())
+    probs[~np.isfinite(masked)] = 0.0
+    probs /= probs.sum()
+    keys = jax.random.split(jax.random.PRNGKey(3), 4000)
+    toks = np.asarray(jax.vmap(
+        lambda k: _sample_token(k, logits, 1.0, 0.9, False, 8)
+    )(keys))[:, 0]
+    counts = np.bincount(toks, minlength=8) / len(toks)
+    np.testing.assert_allclose(counts, probs, atol=0.03)

@@ -22,8 +22,8 @@ from nanorlhf_tpu.parallel import (
 
 
 def test_mesh_resolution():
-    assert MeshConfig(-1, 2, 2).resolve(8) == (2, 2, 2)
-    assert MeshConfig(8, 1, 1).resolve(8) == (8, 1, 1)
+    assert MeshConfig(-1, 2, 2).resolve(8) == (2, 2, 2, 1)
+    assert MeshConfig(8, 1, 1).resolve(8) == (8, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig(3, 2, 2).resolve(8)
 

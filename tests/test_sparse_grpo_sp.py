@@ -1,0 +1,104 @@
+"""SparseGRPO with sequence parallelism: the sp>1 mesh path must train and
+match single-device numerics (VERDICT r1 #3 — SP as a trainer capability)."""
+
+import json
+import zlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer
+from nanorlhf_tpu.entrypoints.grpo_r1 import (
+    build_prompt_dataset,
+    synthetic_math_corpus,
+)
+from nanorlhf_tpu.parallel import MeshConfig, make_mesh
+from nanorlhf_tpu.trainer import AlgoName, RLConfig
+from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
+
+
+def det_reward(pmt_and_responses, responses_ids, tokenizer):
+    """Deterministic pseudo-random reward (crc32, not `hash` — PYTHONHASHSEED
+    must not leak into the equivalence check)."""
+    return np.asarray(
+        [(zlib.crc32(s.encode()) % 17) / 17.0 for s in pmt_and_responses],
+        np.float32,
+    )
+
+
+def _make_trainer(tmp_path, name, mesh):
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    dataset = build_prompt_dataset(synthetic_math_corpus(32), tok, max_prompt_len=16)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / name),
+        response_length=8,
+        temperature=1.0,
+        sample_n=2,
+        kl_coef=0.05,
+        total_episodes=4,    # world=1 -> batch 2 -> 2 updates
+        per_device_train_batch_size=2,
+        gradient_accumulation_steps=1,
+        num_mini_batches=1,
+        learning_rate=1e-3,
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False,
+        save_steps=0,
+        eval_steps=0,
+    )
+    return SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, det_reward,
+                             mesh=mesh)
+
+
+def _lora_leaves(trainer):
+    return [np.asarray(x) for x in jax.tree.leaves(trainer.params["lora"])]
+
+
+def test_sp2_matches_single_device(tmp_path):
+    devs = jax.devices()
+    ctrl = _make_trainer(
+        tmp_path, "ctrl", make_mesh(MeshConfig(1, 1, 1, 1), devices=devs[:1])
+    )
+    sp = _make_trainer(
+        tmp_path, "sp2", make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2])
+    )
+    assert sp._sp_on() and not ctrl._sp_on()
+    s1 = ctrl.train()
+    s2 = sp.train()
+    assert s1["global_step"] == s2["global_step"] == 2
+
+    # same PRNG stream + same deterministic reward -> identical rollouts;
+    # ring attention only reorders f32 reductions, so trained params must
+    # agree to bf16 resolution (LoRA adapters are stored bf16 -> one ulp of
+    # slack at |x|~0.5 is 2e-3)
+    for a, b in zip(_lora_leaves(ctrl), _lora_leaves(sp)):
+        np.testing.assert_allclose(
+            a.astype(np.float32), b.astype(np.float32), rtol=5e-3, atol=2e-3
+        )
+
+    m1 = [json.loads(l) for l in open(tmp_path / "ctrl" / "metrics.jsonl")
+          if "sparse/kept_frac" in l]
+    m2 = [json.loads(l) for l in open(tmp_path / "sp2" / "metrics.jsonl")
+          if "sparse/kept_frac" in l]
+    for a, b in zip(m1, m2):
+        assert abs(a["loss/policy_avg_new"] - b["loss/policy_avg_new"]) < 1e-3
+        assert abs(a["objective/kl_rollout_old"] - b["objective/kl_rollout_old"]) < 1e-3
+
+
+def test_sp_with_fsdp_trains(tmp_path):
+    """sp=2 x fsdp=2: params sharded at rest, gathered per layer inside the
+    SP forward — one update runs and stays finite."""
+    devs = jax.devices()
+    tr = _make_trainer(
+        tmp_path, "spfsdp", make_mesh(MeshConfig(1, 2, 1, 2), devices=devs[:4])
+    )
+    assert tr._sp_on() and tr._fsdp_axis() == "fsdp"
+    tr.train(num_updates=1)
+    m = [json.loads(l) for l in open(tmp_path / "spfsdp" / "metrics.jsonl")
+         if "sparse/kept_frac" in l]
+    assert m and np.isfinite(m[-1]["loss/policy_avg_new"])
