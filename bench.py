@@ -87,6 +87,13 @@ def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
         try:
             payload = json.loads(line)
             if isinstance(payload, dict) and "metric" in payload:
+                if payload.get("error") and payload.get("value", 0) == 0:
+                    # the child emitted an error payload (e.g. fast-raising
+                    # TPU init failure): that is a FAILED attempt — retries
+                    # and the CPU fallback must still run. Hand the payload
+                    # up so the final failure can emit the most informative
+                    # one.
+                    return None, json.dumps(payload)
                 return payload, ""
         except json.JSONDecodeError:
             continue
@@ -129,6 +136,16 @@ def orchestrate() -> int:
             _emit(payload)
             return 0
         errors.append(f"cpu fallback: {err}")
+    # prefer the last structured child error payload over a generic one
+    for err in reversed(errors):
+        tail = err.split(": ", 1)[-1]
+        try:
+            payload = json.loads(tail)
+            if isinstance(payload, dict) and "metric" in payload:
+                _emit(payload)
+                return 0
+        except json.JSONDecodeError:
+            continue
     _emit(_error_payload(" | ".join(errors)))
     return 0
 

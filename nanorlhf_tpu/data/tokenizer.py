@@ -33,6 +33,10 @@ class ToyTokenizer:
         self.eos_token_id = 1
         self.unk_token_id = 2
         self._id_to_word: dict[int, str] = {}
+        # the decode cache fills during encode; encoding in a forked pool
+        # would leave the PARENT's cache empty and decode to <unk:N> — keep
+        # this tokenizer on the serial path (see data/datasets.encode_texts)
+        self.parallel_safe = False
 
     def _word_id(self, word: str) -> int:
         if word == self.pad_token:

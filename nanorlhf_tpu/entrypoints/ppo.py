@@ -52,6 +52,8 @@ def main(run_value_init: bool = True, value_init_cfg: ValueInitConfig | None = N
             response_length=cfg.response_length, temperature=cfg.temperature,
             kl_coef=cfg.kl_coef, gamma=cfg.gamma, vcfg=vcfg,
             whiten_rewards=cfg.whiten_rewards, lora_scale=trainer.lora_scale,
+            # regress only the value tree's LoRA partition (`PPO/ppo.py:317-332`)
+            value_lora_cfg=trainer.value_lora_cfg,
             key=jax.random.PRNGKey(cfg.seed + 2),
         )
 

@@ -5,6 +5,13 @@ from nanorlhf_tpu.rewards.math_grader import (
     is_correct,
     call_with_timeout,
 )
+from nanorlhf_tpu.rewards.eval_dispatch import is_correct_item
+from nanorlhf_tpu.rewards.answer_extraction import (
+    extract_answer,
+    extract_math_answer,
+    get_all_boxed,
+    get_extractor,
+)
 from nanorlhf_tpu.rewards.builders import (
     make_binary_math_reward,
     make_rm_reward,
@@ -13,10 +20,15 @@ from nanorlhf_tpu.rewards.builders import (
 
 __all__ = [
     "get_boxed",
+    "get_all_boxed",
     "normalize_math_answer",
     "math_answers_equal",
     "is_correct",
+    "is_correct_item",
     "call_with_timeout",
+    "extract_answer",
+    "extract_math_answer",
+    "get_extractor",
     "make_binary_math_reward",
     "make_rm_reward",
     "make_rule_reward",

@@ -1,0 +1,52 @@
+"""Multi-benchmark correctness dispatch — `is_correct` over single answers,
+answer lists, and set unions.
+
+Capability parity with the vendored Qwen eval script
+(`/root/reference/examples/r1-v0/utils/eval/eval_script.py:6-44`):
+
+- list-vs-list predictions use bipartite coverage — every predicted answer
+  must match some ground-truth answer AND every ground-truth answer must be
+  matched (multi-part answers in any order);
+- strings containing ``\\cup`` split into their union pieces and recurse as
+  lists;
+- scalar strings grade by numeric closeness (comma-stripped, ``prec``
+  tolerance), exact match, then the full `math_answers_equal` ladder.
+"""
+
+from __future__ import annotations
+
+import re
+
+from nanorlhf_tpu.rewards.math_grader import math_answers_equal
+
+
+def is_correct_item(pred, answer, prec: float = 1e-3) -> bool:
+    if isinstance(pred, list) and isinstance(answer, list):
+        pred_matched: set[int] = set()
+        ans_matched: set[int] = set()
+        for i, p in enumerate(pred):
+            for j, a in enumerate(answer):
+                if is_correct_item(p, a, prec=prec):
+                    pred_matched.add(i)
+                    ans_matched.add(j)
+        return len(pred_matched) == len(pred) and len(ans_matched) == len(answer)
+    if isinstance(pred, str) and isinstance(answer, str):
+        if "\\cup" in pred and "\\cup" in answer:
+            return is_correct_item(
+                pred.split("\\cup"), answer.split("\\cup"), prec=prec
+            )
+        try:
+            if abs(
+                float(re.sub(r",", "", pred)) - float(re.sub(r",", "", answer))
+            ) < prec:
+                return True
+        except (ValueError, TypeError):
+            pass
+        return bool(answer and pred == answer) or math_answers_equal(pred, answer)
+    # mixed scalar/list: wrap the scalar (the reference raises; grading a
+    # reward must not crash the training loop)
+    if isinstance(pred, str):
+        return is_correct_item([pred], answer, prec=prec)
+    if isinstance(answer, str):
+        return is_correct_item(pred, [answer], prec=prec)
+    return False

@@ -49,6 +49,13 @@ class SamplingParams:
     # pre-trim and recover the exact full-vocab nucleus at full-sort cost.
     # Ignored when top_p >= 1.0 (that path is always exact full-vocab).
     top_k: int = 64
+    # capture the FULL-distribution logprob of each sampled token during
+    # decode (one extra logsumexp per step — the logits are already there).
+    # `generate` then returns (tokens, logprobs), letting the trainer skip
+    # the policy half of the scoring pass (ROADMAP #5b). The captured values
+    # equal `logprobs_from_logits(logits, tokens, temperature)` up to
+    # decode-vs-scoring numerics; the trainer logs the residual ratio drift.
+    capture_logprobs: bool = False
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -102,10 +109,19 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64):
     )[..., 0].astype(jnp.int32)
 
 
+def _token_logprob(logits, tok, temperature):
+    """Full-distribution logprob of `tok` at the sampling temperature — the
+    same quantity the scoring pass computes (`logprobs_from_logits`)."""
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    lse = jax.nn.logsumexp(scaled, axis=-1)
+    return jnp.take_along_axis(scaled, tok[..., None], axis=-1)[..., 0] - lse
+
+
 @partial(
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
-                     "temperature", "top_p", "greedy", "lora_scale", "top_k"),
+                     "temperature", "top_p", "greedy", "lora_scale", "top_k",
+                     "capture_logprobs"),
 )
 def generate_tokens(
     params: dict,
@@ -122,8 +138,10 @@ def generate_tokens(
     greedy: bool = False,
     lora_scale: float = 1.0,
     top_k: int = 64,
+    capture_logprobs: bool = False,
 ) -> jnp.ndarray:
-    """Core jitted loop: one sample per row. Returns [B, max_tokens] int32."""
+    """Core jitted loop: one sample per row. Returns [B, max_tokens] int32,
+    or (tokens, logprobs [B, max_tokens] f32) with capture_logprobs."""
     B, Tp = prompt_ids.shape
     T_max = Tp + max_tokens
     prompt_mask = prompt_mask.astype(bool)
@@ -137,17 +155,20 @@ def generate_tokens(
     key_mask0 = jnp.zeros((B, T_max), bool).at[:, :Tp].set(prompt_mask)
 
     out0 = jnp.full((B, max_tokens), pad_token_id, jnp.int32)
+    lp0 = jnp.zeros((B, max_tokens), jnp.float32)
     key, k0 = jax.random.split(key)
     tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy, top_k)
     out0 = out0.at[:, 0].set(tok0)
+    if capture_logprobs:
+        lp0 = lp0.at[:, 0].set(_token_logprob(first_logits, tok0, temperature))
     done0 = tok0 == eos_token_id
 
     def cond(state):
-        step, _, _, _, done, _, _ = state
+        step, _, _, _, _, done, _, _ = state
         return (step < max_tokens) & ~jnp.all(done)
 
     def body(state):
-        step, out, caches, key_mask, done, cur_tok, key = state
+        step, out, lp_out, caches, key_mask, done, cur_tok, key = state
         # write current token's KV at cache slot Tp + step - 1 ... wait: token t
         # sampled from logits at position prompt_len + step - 1; feed it in now.
         cache_slot = Tp + step - 1
@@ -160,15 +181,17 @@ def generate_tokens(
         key, k = jax.random.split(key)
         tok = _sample_token(k, logits, temperature, top_p, greedy, top_k)
         tok = jnp.where(done, pad_token_id, tok)
-        out = jnp.where(
-            (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None], tok[:, None], out
-        )
+        write = (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None]
+        out = jnp.where(write, tok[:, None], out)
+        if capture_logprobs:
+            lp = _token_logprob(logits, tok, temperature)
+            lp_out = jnp.where(write, lp[:, None], lp_out)
         done = done | (tok == eos_token_id)
-        return step + 1, out, caches, key_mask, done, tok, key
+        return step + 1, out, lp_out, caches, key_mask, done, tok, key
 
-    state = (jnp.int32(1), out0, caches, key_mask0, done0, tok0, key)
-    _, out, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
-    return out
+    state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0, tok0, key)
+    _, out, lp_out, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
+    return (out, lp_out) if capture_logprobs else out
 
 
 def generate(
@@ -182,7 +205,8 @@ def generate(
     pad_token_id: int,
     lora_scale: float = 1.0,
 ) -> jnp.ndarray:
-    """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per prompt."""
+    """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
+    prompt; (tokens, logprobs) when `sampling.capture_logprobs`."""
     if sampling.n > 1:
         prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
         prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
@@ -200,4 +224,5 @@ def generate(
         greedy=sampling.greedy,
         lora_scale=lora_scale,
         top_k=sampling.top_k,
+        capture_logprobs=sampling.capture_logprobs,
     )

@@ -66,6 +66,11 @@ class RLConfig:
     num_mini_batches: int = 16
     num_ppo_epochs: int = 1
     local_rollout_forward_batch_size: Optional[int] = None  # None → memory formula
+    # opt-in: reuse the sampler's per-token logprobs as the rollout-policy
+    # logprobs, skipping the policy half of the scoring pass (the ref pass
+    # still runs). Decode-vs-scoring numerics make epoch-1 ratios deviate
+    # from exactly 1; the drift is logged as sampler_capture/ratio_drift_new.
+    sampler_logprob_capture: bool = False
 
     # ---- optimization ----
     learning_rate: float = 6e-6
@@ -95,6 +100,12 @@ class RLConfig:
     use_lora: bool = True
     lora_r: int = 64
     lora_alpha: int = 16
+    # value-model LoRA (`PPO/ppo.py:141-159`): adapters + score head + embed
+    # trainable, backbone frozen — without it the 1.5B value tree is full-FT
+    # and pays ~3 GB of extra Adam state the reference doesn't
+    value_use_lora: bool = True
+    value_lora_r: int = 64
+    value_lora_alpha: int = 16
 
     # ---- memory / kernels ----
     gradient_checkpointing: bool = True

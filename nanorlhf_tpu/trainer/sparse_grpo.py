@@ -111,6 +111,44 @@ class SparseGRPOTrainer(RLTrainer):
         self._bucket_score_cached = score
         return score
 
+    def _bucket_ref_score_fn(self):
+        """Ref-only bucket scorer (sampler-logprob-capture path)."""
+        if hasattr(self, "_bucket_ref_cached"):
+            return self._bucket_ref_cached
+        mcfg, cfg = self.mcfg, self.cfg
+        pad_id = self.tokenizer.pad_token_id
+
+        @partial(jax.jit, static_argnums=(2,))
+        def score_ref(ref_params, qr, context_length: int):
+            resp = qr[:, context_length:]
+            return logprobs_from_logits(
+                padded_forward_logits(ref_params, mcfg, qr, pad_id,
+                                      response_context_length=context_length),
+                resp, cfg.temperature,
+            )
+
+        self._bucket_ref_cached = score_ref
+        return score_ref
+
+    def _sp_ref_score_fn(self):
+        if hasattr(self, "_sp_ref_cached"):
+            return self._sp_ref_cached
+        from nanorlhf_tpu.parallel.sp import sp_score_logprobs
+
+        mcfg, cfg, mesh = self.mcfg, self.cfg, self.mesh
+        pad_id = self.tokenizer.pad_token_id
+        fsdp_axis = self._fsdp_axis()
+
+        @partial(jax.jit, static_argnums=(2,))
+        def score_ref(ref_params, qr, context_length: int):
+            return sp_score_logprobs(
+                ref_params, mcfg, qr, pad_id, cfg.temperature, mesh,
+                fsdp_axis=fsdp_axis,
+            )[:, context_length - 1 : -1]
+
+        self._sp_ref_cached = score_ref
+        return score_ref
+
     def _bucket_grad_fn(self):
         if hasattr(self, "_bucket_grad_cached"):
             return self._bucket_grad_cached
@@ -289,9 +327,14 @@ class SparseGRPOTrainer(RLTrainer):
             acc = float(self.accuracy_func(self))
             self.logger.log(0, 0, {"initial_accuracy": acc})
 
+        capture = cfg.sampler_logprob_capture
+        ref_fn = (
+            (self._sp_ref_score_fn() if sp_on else self._bucket_ref_score_fn())
+            if capture else None
+        )
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
-            max_tokens=cfg.response_length,
+            max_tokens=cfg.response_length, capture_logprobs=capture,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])
@@ -307,11 +350,18 @@ class SparseGRPOTrainer(RLTrainer):
             # ---- rollout + reward -----------------------------------------
             self.key, gk = jax.random.split(self.key)
             q_j = jnp.asarray(queries)
-            responses = np.asarray(generate(
+            gen_out = generate(
                 self.params, self.mcfg, q_j, q_j != pad_id, gk, sampling,
                 eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
-            ))
+            )
+            if capture:
+                responses, captured_lp = gen_out
+                responses = np.asarray(responses)
+                captured_lp = np.asarray(captured_lp)
+            else:
+                responses = np.asarray(gen_out)
+                captured_lp = None
             question_strings = [
                 q.replace(tok.pad_token, "") for q in tok.batch_decode(queries)
             ]
@@ -332,6 +382,8 @@ class SparseGRPOTrainer(RLTrainer):
             rows = np.arange(batch_size)
             scores = adv_flat.reshape(batch_size, n)[rows, keep]
             responses = responses.reshape(batch_size, n, -1)[rows, keep]
+            if captured_lp is not None:
+                captured_lp = captured_lp.reshape(batch_size, n, -1)[rows, keep]
 
             # ---- sparse filter (`grpo_r1_trainer.py:565-568`) -------------
             nz = np.where(scores != 0)[0]
@@ -340,6 +392,8 @@ class SparseGRPOTrainer(RLTrainer):
                 print(f"[sparse-grpo] update {update}: all advantages zero, skipping")
                 continue
             scores, queries_f, responses_f = scores[nz], queries[nz], responses[nz]
+            if captured_lp is not None:
+                captured_lp = captured_lp[nz]
 
             # ---- de-pad (`:571-582`), menu-rounded ------------------------
             from nanorlhf_tpu.trainer.bucketing import depad_queries
@@ -370,6 +424,10 @@ class SparseGRPOTrainer(RLTrainer):
                 (len(scores), max_resp), INVALID_LOGPROB, np.float32
             )
             ref_logprobs = logprobs.copy()
+            if captured_lp is not None:
+                # policy logprobs came from the sampler; buckets below only
+                # run the ref forward (half the scoring work)
+                logprobs = captured_lp[:, :max_resp].astype(np.float32)
             for idxs in buckets:
                 blen = round_up_to_menu(int(qr_len[idxs].max()), self._len_menu)
                 blen = min(max(blen, context_length + 1), qr.shape[1])
@@ -378,13 +436,18 @@ class SparseGRPOTrainer(RLTrainer):
                 padded = pad_rows(
                     {"qr": qr[idxs][:, :blen]}, rows_b, {"qr": pad_id}
                 )
-                lp, rlp = score_fn(
-                    self.params, self.ref_params, jnp.asarray(padded["qr"]),
-                    context_length,
-                )
                 width = blen - context_length
-                logprobs[idxs, :width] = np.asarray(lp)[: len(idxs)]
-                ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
+                if capture:
+                    rlp = ref_fn(self.ref_params, jnp.asarray(padded["qr"]),
+                                 context_length)
+                    ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
+                else:
+                    lp, rlp = score_fn(
+                        self.params, self.ref_params, jnp.asarray(padded["qr"]),
+                        context_length,
+                    )
+                    logprobs[idxs, :width] = np.asarray(lp)[: len(idxs)]
+                    ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
 
             # ---- masks + advantages ---------------------------------------
             seq_len = np.asarray(first_true_indices(jnp.asarray(post) == pad_id) - 1)
@@ -480,6 +543,9 @@ class SparseGRPOTrainer(RLTrainer):
                 "eps": cfg.adam_eps,
                 "sparse/kept_frac": kept_frac,
                 "eval_response_length": log_responses_length,
+                **({"sampler_capture/ratio_drift_new": abs(
+                    agg.get("ratio_mean", 1.0) - 1.0
+                )} if capture else {}),
                 "sec_per_episode": (time.time() - t_start) / cfg.batch_size,
                 "episode": self.state["episode"],
             }

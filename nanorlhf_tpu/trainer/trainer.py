@@ -154,6 +154,24 @@ class RLTrainer:
             )}
         self.lora_scale = self.lora_cfg.scale if self.lora_cfg else 1.0
 
+        # value-model LoRA (`PPO/ppo.py:301-332`): adapters + score + embed
+        # train, backbone frozen — the Adam state for the value tree shrinks
+        # from full-model to adapter-sized
+        self.value_lora_cfg = (
+            LoraConfig(r=config.value_lora_r, alpha=config.value_lora_alpha)
+            if (config.value_use_lora and value_params is not None)
+            else None
+        )
+        if self.value_lora_cfg and "lora" not in value_params:
+            self.key, k = jax.random.split(self.key)
+            value_params = {**value_params, "lora": init_lora_params(
+                self.mcfg, self.value_lora_cfg, k,
+                dtype=value_params["embed_tokens"].dtype,
+            )}
+        self.value_lora_scale = (
+            self.value_lora_cfg.scale if self.value_lora_cfg else 1.0
+        )
+
         # ref policy = frozen copy of the base weights (the reference loads
         # the same SFT model twice, `GRPO/grpo.py:218-224`); sharded alike.
         # Copy-on-intake: device_put with an unchanged sharding ALIASES the
@@ -208,7 +226,12 @@ class RLTrainer:
     def _trainable_tree_mask(self, train_tree):
         mask = {"policy": trainable_mask(train_tree["policy"], self.lora_cfg)}
         if train_tree.get("value") is not None:
-            mask["value"] = jax.tree.map(lambda _: True, train_tree["value"])
+            vmask = trainable_mask(train_tree["value"], self.value_lora_cfg)
+            if self.value_lora_cfg is not None:
+                # score head always trains (`value_modules_to_save` parity,
+                # `PPO/ppo.py:157-159`); trainable_mask doesn't know it
+                vmask["score"] = True
+            mask["value"] = vmask
         return mask
 
     def _partition(self, train_tree):
@@ -279,6 +302,7 @@ class RLTrainer:
         cfg, mcfg = self.cfg, self.mcfg
         algo = self.algo
         lora_scale = self.lora_scale
+        value_lora_scale = self.value_lora_scale
         remat = cfg.gradient_checkpointing
         pad_id = self.tokenizer.pad_token_id
         optimizer = self.optimizer
@@ -326,7 +350,7 @@ class RLTrainer:
                 )
                 vpred = score_forward(
                     train_tree["value"], mcfg, mb["query_responses"], pad_id,
-                    remat=remat,
+                    lora_scale=value_lora_scale, remat=remat,
                 )[:, context_length - 1 : -1, 0]
                 vpred = jnp.where(mb["padding_mask_p1"], 0.0, vpred)
                 vf_loss, vf_aux = value_loss_clipped(
@@ -422,6 +446,28 @@ class RLTrainer:
         self._score_fn_cached = score
         return score
 
+    def _ref_score_fn(self):
+        """Ref-policy-only scorer — the sampler-logprob-capture path skips
+        the policy forward entirely."""
+        if hasattr(self, "_ref_score_cached"):
+            return self._ref_score_cached
+        mcfg, cfg = self.mcfg, self.cfg
+        pad_id = self.tokenizer.pad_token_id
+
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(2,))
+        def score_ref(ref_params, query_responses, context_length: int):
+            responses = query_responses[:, context_length:]
+            ref_logits = padded_forward_logits(
+                ref_params, mcfg, query_responses, pad_id,
+                response_context_length=context_length,
+            )
+            return logprobs_from_logits(ref_logits, responses, cfg.temperature)
+
+        self._ref_score_cached = score_ref
+        return score_ref
+
     # ------------------------------------------------------------------ #
     # the training loop
     # ------------------------------------------------------------------ #
@@ -434,9 +480,10 @@ class RLTrainer:
         score_fn = self._score_chunk_fn()
 
         n = cfg.sample_n if self.algo in (AlgoName.GRPO, AlgoName.RLOO, AlgoName.RAFT) else 1
+        capture = cfg.sampler_logprob_capture
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
-            max_tokens=cfg.response_length,
+            max_tokens=cfg.response_length, capture_logprobs=capture,
         )
 
         # after a resume, the default budget is the REMAINING updates, not a
@@ -467,13 +514,19 @@ class RLTrainer:
 
             # ---- ROLLOUT -------------------------------------------------
             self.key, gen_key = jax.random.split(self.key)
+            captured_lp = None
             with self.timer.phase("rollout"):
-                responses = generate(
+                gen_out = generate(
                     self.params, self.mcfg, queries_j, prompt_mask, gen_key,
                     sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                     lora_scale=self.lora_scale,
                 )                                           # [B*n, T]
-                responses.block_until_ready()
+                if capture:
+                    responses, captured_lp = gen_out
+                    captured_lp = np.asarray(captured_lp)
+                else:
+                    responses = gen_out
+                jax.block_until_ready(responses)
             greedy_responses = None
             if self.algo == AlgoName.REMAX:
                 # extra greedy rollout as baseline (`ReMax/remax_trainer.py:166-185`)
@@ -526,6 +579,8 @@ class RLTrainer:
                 rows = np.arange(batch_size)
                 grpo_adv = adv_flat.reshape(batch_size, n)[rows, keep]
                 responses_np = responses_np.reshape(batch_size, n, -1)[rows, keep]
+                if captured_lp is not None:
+                    captured_lp = captured_lp.reshape(batch_size, n, -1)[rows, keep]
                 log_scores = log_scores_all.reshape(batch_size, n)[rows, keep]
                 responses_decoded = [
                     responses_decoded[i * n + j] for i, j in enumerate(keep)
@@ -545,17 +600,25 @@ class RLTrainer:
             )
             chunk = max(1, min(total, chunk))
             logprobs_l, ref_logprobs_l = [], []
+            ref_fn = self._ref_score_fn() if capture else None
             with self.timer.phase("logprob"):
                 for i in range(0, total, chunk):
                     n_real = min(chunk, total - i)
-                    lp, rlp = score_fn(
-                        self.params, self.ref_params,
-                        jnp.asarray(pad_chunk(qr[i : i + chunk], chunk)),
-                        context_length,
-                    )
-                    logprobs_l.append(np.asarray(lp)[:n_real])
-                    ref_logprobs_l.append(np.asarray(rlp)[:n_real])
-            logprobs = np.concatenate(logprobs_l)
+                    rows_c = jnp.asarray(pad_chunk(qr[i : i + chunk], chunk))
+                    if capture:
+                        # policy logprobs came from the sampler; only the
+                        # ref pass runs — half the scoring forwards
+                        rlp = ref_fn(self.ref_params, rows_c, context_length)
+                        ref_logprobs_l.append(np.asarray(rlp)[:n_real])
+                    else:
+                        lp, rlp = score_fn(
+                            self.params, self.ref_params, rows_c, context_length,
+                        )
+                        logprobs_l.append(np.asarray(lp)[:n_real])
+                        ref_logprobs_l.append(np.asarray(rlp)[:n_real])
+            logprobs = (
+                captured_lp if capture else np.concatenate(logprobs_l)
+            ).astype(np.float32)
             ref_logprobs = np.concatenate(ref_logprobs_l)
 
             # ---- response post-processing ---------------------------------
@@ -684,6 +747,13 @@ class RLTrainer:
             if "vf_loss" in agg:
                 metrics["loss/value_avg_new"] = agg["vf_loss"]
                 metrics["val/clipfrac_avg_new"] = agg.get("vf_clipfrac", 0.0)
+            if capture:
+                # with exact scoring the epoch-1 ratio is identically 1; any
+                # deviation here is decode-vs-scoring numerics — the guard
+                # for the captured-logprob shortcut
+                metrics["sampler_capture/ratio_drift_new"] = abs(
+                    agg.get("ratio_mean", 1.0) - 1.0
+                )
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
             if self.state["global_step"] % cfg.logging_steps == 0:
@@ -880,10 +950,12 @@ class RLTrainer:
             from functools import partial
 
             mcfg, pad_id = self.mcfg, self.tokenizer.pad_token_id
+            value_lora_scale = self.value_lora_scale
 
             @partial(jax.jit, static_argnums=(2,))
             def value_fn(vparams, qr_chunk, context_length: int):
-                v = score_forward(vparams, mcfg, qr_chunk, pad_id)[:, :, 0]
+                v = score_forward(vparams, mcfg, qr_chunk, pad_id,
+                                  lora_scale=value_lora_scale)[:, :, 0]
                 return v[:, context_length - 1 : -1]
 
             self._value_fn = value_fn

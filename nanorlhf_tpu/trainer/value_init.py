@@ -66,9 +66,19 @@ def finetune_value_model(
     vcfg: ValueInitConfig = ValueInitConfig(),
     whiten_rewards: bool = False,
     lora_scale: float = 1.0,
+    value_lora_cfg=None,
     key: jax.Array | None = None,
 ) -> dict:
-    """Returns value_params regressed onto the rollout returns."""
+    """Returns value_params regressed onto the rollout returns.
+
+    `value_lora_cfg` (a LoraConfig) restricts the regression to the value
+    tree's trainable partition — LoRA adapters + score head + embed — with
+    the frozen backbone combined back in for each forward (the reference
+    value initializer fine-tunes the PEFT-wrapped value model,
+    `PPO/ppo.py:369-380`). The value tree must already carry its "lora"
+    subtree (RLTrainer initializes it; standalone callers use
+    init_lora_params).
+    """
     key = key if key is not None else jax.random.PRNGKey(0)
     pad_id, eos_id = tokenizer.pad_token_id, tokenizer.eos_token_id
     prompts = prompts[: vcfg.train_data_size]
@@ -150,39 +160,62 @@ def finetune_value_model(
     perm = np.random.default_rng(0).permutation(n)
     tr, va = perm[:n_train], perm[n_train:]
 
+    # trainable/frozen partition: full tree without LoRA, else adapters +
+    # score + embed only (Adam state never materializes for the backbone)
+    if value_lora_cfg is not None:
+        from nanorlhf_tpu.core.lora import trainable_mask
+
+        vmask = trainable_mask(value_params, value_lora_cfg)
+        vmask["score"] = True
+        value_lora_scale = value_lora_cfg.scale
+    else:
+        vmask = jax.tree.map(lambda _: True, value_params)
+        value_lora_scale = 1.0
+    trainable = jax.tree.map(lambda p, m: p if m else None, value_params, vmask)
+    frozen = jax.tree.map(lambda p, m: None if m else p, value_params, vmask)
+
+    def combine(t, f):
+        return jax.tree.map(
+            lambda a, b: b if a is None else a, t, f,
+            is_leaf=lambda x: x is None,
+        )
+
     # reduce-on-plateau via an inject_hyperparams LR the host halves when the
     # val loss stalls (the reference's lr_scheduler_type, `PPO/ppo.py:92-93`)
     optimizer = optax.inject_hyperparams(optax.adam)(
         learning_rate=vcfg.learning_rate
     )
-    opt_state = optimizer.init(value_params)
+    opt_state = optimizer.init(trainable)
 
-    def vloss(vp, ids, labels, pm1):
-        vpred = score_forward(vp, model_config, ids, pad_id)[:, context_length - 1 : -1, 0]
+    def vloss(t, ids, labels, pm1):
+        vp = combine(t, frozen)
+        vpred = score_forward(
+            vp, model_config, ids, pad_id, lora_scale=value_lora_scale
+        )[:, context_length - 1 : -1, 0]
         vpred = jnp.where(pm1, 0.0, vpred)
         return 0.5 * masked_mean(jnp.square(vpred - labels), ~pm1)
 
     @jax.jit
-    def step(vp, opt_state, ids, labels, pm1):
-        loss, grads = jax.value_and_grad(vloss)(vp, ids, labels, pm1)
+    def step(t, opt_state, ids, labels, pm1):
+        loss, grads = jax.value_and_grad(vloss)(t, ids, labels, pm1)
         updates, opt_state = optimizer.update(grads, opt_state)
-        return optax.apply_updates(vp, updates), opt_state, loss
+        return optax.apply_updates(t, updates), opt_state, loss
 
     eval_loss_fn = jax.jit(vloss)
 
     bs = vcfg.per_device_train_batch_size
-    best_val, best_params, patience = np.inf, value_params, 0
+    best_val, best_trainable, patience = np.inf, trainable, 0
     plateau_wait = 0
     for epoch in range(vcfg.num_train_epochs):
         ep_perm = np.random.default_rng(epoch).permutation(len(tr))
         for i in range(0, len(tr) - bs + 1, bs):
             idx = tr[ep_perm[i : i + bs]]
-            value_params, opt_state, _ = step(
-                value_params, opt_state, jnp.asarray(qr[idx]),
+            trainable, opt_state, _ = step(
+                trainable, opt_state, jnp.asarray(qr[idx]),
                 jnp.asarray(returns[idx]), jnp.asarray(padding_mask_p1[idx]),
             )
         val_losses = [
-            float(eval_loss_fn(value_params, jnp.asarray(qr[va[i : i + bs]]),
+            float(eval_loss_fn(trainable, jnp.asarray(qr[va[i : i + bs]]),
                                jnp.asarray(returns[va[i : i + bs]]),
                                jnp.asarray(padding_mask_p1[va[i : i + bs]])))
             for i in range(0, max(1, len(va) - bs + 1), bs)
@@ -190,7 +223,7 @@ def finetune_value_model(
         val_loss = float(np.mean(val_losses))
         print(f"[value-init] epoch {epoch}: val_loss={val_loss:.5f}")
         if val_loss < best_val - 1e-6:
-            best_val, best_params, patience = val_loss, value_params, 0
+            best_val, best_trainable, patience = val_loss, trainable, 0
             plateau_wait = 0
         else:
             patience += 1
@@ -202,4 +235,4 @@ def finetune_value_model(
                 plateau_wait = 0
             if patience >= vcfg.early_stopping_patience:
                 break
-    return best_params
+    return combine(best_trainable, frozen)

@@ -1,0 +1,211 @@
+"""Golden grading pairs — behaviors the reference toolkits grade correctly.
+
+Each row re-states a case the vendored DeepSeek/Qwen toolkits handle
+(`/root/reference/examples/r1-v0/utils/toolkit_for_MATH/latex_answer_check.py:52-123`,
+`.../eval/eval_utils.py:181-278`, `.../eval/eval_script.py:6-44`,
+`.../data_processing/answer_extraction.py:207-338`). Round 1's compact
+grader mis-graded several of these shapes (VERDICT r1 missing #4); the suite
+is written against the reference semantics FIRST, implementation second.
+
+Run both in-process and through the timeout subprocess guard.
+"""
+
+import pytest
+
+from nanorlhf_tpu.rewards.math_grader import is_correct, math_answers_equal
+
+# (prediction, ground truth, expected verdict)
+EQUIV_GOLDEN = [
+    # --- percentage variants (eval_utils.math_equal include_percentage) ---
+    ("50", "50\\%", True),
+    ("0.5", "50\\%", True),
+    ("50%", "0.5", True),
+    ("0.17", "17", True),          # 17/100 variant
+    ("3", "5\\%", False),
+    # --- numeric closeness (abs_tol 1e-3 digits; rel_tol 1e-3 symbolic) ---
+    ("0.333", "\\frac{1}{3}", True),
+    ("3.1416", "\\pi", True),
+    ("3.1429", "\\frac{22}{7}", True),
+    ("0.25", "\\frac{1}{3}", False),
+    # --- intervals / tuples, elementwise (eval_utils.math_equal:225-231;
+    #     bracket TYPES are not compared — reference semantics) ---
+    ("(1, 2]", "(1,2]", True),
+    ("[1,2)", "(1,2)", True),
+    ("(0, 1)", "(0, 2)", False),
+    ("(-\\infty, 5)", "(-\\infty,5)", True),
+    ("(\\frac{1}{2}, 3)", "(0.5, 3)", True),
+    ("(1, 2, 3)", "(1, 2)", False),
+    # --- matrices (eval_utils.math_equal:233-253) ---
+    ("\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}",
+     "\\begin{bmatrix}1 & 2 \\\\ 3 & 4\\end{bmatrix}", True),
+    ("\\begin{pmatrix}\\frac{1}{2}\\\\0\\end{pmatrix}",
+     "\\begin{pmatrix}0.5\\\\0\\end{pmatrix}", True),
+    ("\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}",
+     "\\begin{pmatrix}1&2\\\\3&5\\end{pmatrix}", False),
+    ("\\begin{pmatrix}1&2\\end{pmatrix}",
+     "\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}", False),
+    # --- equations / relations (eval_utils.math_equal:255-266) ---
+    ("x=5", "5", True),
+    ("5", "x = 5", True),
+    ("y = 2x + 3", "2x + 3 = y", True),
+    ("x + y = 1", "y = 1 - x", True),
+    ("y = 2x", "y = 3x", False),
+    ("x \\le 5", "x\\leq5", True),
+    ("x \\ge 5", "x \\le 5", False),
+    ("x < 3", "x<3", True),
+    # --- set unions (eval_script.is_correct \cup split) ---
+    ("(-\\infty,0)\\cup(1,\\infty)", "(-\\infty, 0) \\cup (1, \\infty)", True),
+    ("(-\\infty,0)\\cup(2,\\infty)", "(-\\infty, 0) \\cup (1, \\infty)", False),
+    # --- text answers survive \text stripping ---
+    ("\\text{east}", "east", True),
+    # --- plain regressions the round-1 grader already handled ---
+    ("\\frac{1}{2}", "0.5", True),
+    ("\\sqrt{8}", "2\\sqrt{2}", True),
+    ("1{,}000", "1000", True),
+]
+
+
+@pytest.mark.parametrize("pred,gt,want", EQUIV_GOLDEN)
+def test_equivalence_golden_inprocess(pred, gt, want):
+    assert math_answers_equal(pred, gt) is want
+
+
+def test_equivalence_golden_through_subprocess_guard():
+    """The same verdicts must survive the call_with_timeout path the training
+    reward uses (`grpo_r1.py:179-192` parity)."""
+    for pred, gt, want in EQUIV_GOLDEN[:12]:  # subprocess spin-up is slow; sample
+        assert is_correct(pred, gt, timeout=5.0, use_subprocess=True) is want, (
+            pred, gt, want
+        )
+
+
+# ---------------------------------------------------------------------------
+# multi-answer dispatch (eval_script.is_correct:6-44)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_answer_bipartite_match():
+    from nanorlhf_tpu.rewards.eval_dispatch import is_correct_item
+
+    assert is_correct_item(["1", "2"], ["2", "1"]) is True
+    assert is_correct_item(["1"], ["1", "2"]) is False      # answer 2 unmatched
+    assert is_correct_item(["1", "3"], ["1", "2"]) is False
+    assert is_correct_item("0.5", "\\frac{1}{2}") is True
+    assert is_correct_item("42", "41") is False
+
+
+def test_numeric_prec_tolerance():
+    from nanorlhf_tpu.rewards.eval_dispatch import is_correct_item
+
+    assert is_correct_item("3.14159", "3.1414", prec=1e-3) is True
+    assert is_correct_item("1,000", "1000") is True          # comma stripping
+
+
+# ---------------------------------------------------------------------------
+# per-benchmark extraction (answer_extraction.py:245-338)
+# ---------------------------------------------------------------------------
+
+
+def test_extract_math_answer_boxed_exhaust():
+    from nanorlhf_tpu.rewards.answer_extraction import extract_math_answer
+
+    text = "First \\boxed{3} then later \\boxed{\\frac{1}{2}}."
+    assert extract_math_answer("q", text, "math") == ["3", "\\frac{1}{2}"]
+
+
+def test_extract_math_answer_comma_split():
+    from nanorlhf_tpu.rewards.answer_extraction import extract_math_answer
+
+    q = "Find all roots, separated by commas."
+    text = "The answer is \\boxed{1, 2, 3}"
+    assert extract_math_answer(q, text, "math") == ["1", "2", "3"]
+
+
+def test_extract_math_answer_text_and_split():
+    from nanorlhf_tpu.rewards.answer_extraction import extract_math_answer
+
+    text = "\\boxed{3 \\text{ and } 5}"
+    assert extract_math_answer("q", text, "math") == ["3", "5"]
+
+
+def test_extract_gsm_last_number():
+    from nanorlhf_tpu.rewards.answer_extraction import (
+        extract_gsm_few_shot_cot_answer,
+    )
+
+    assert extract_gsm_few_shot_cot_answer(
+        "q", "So 4 + 5 = 9 dollars total.", "gsm8k"
+    ) == "9"
+    # few-shot echo truncation at "Q: "
+    assert extract_gsm_few_shot_cot_answer(
+        "q", "The total is 12 dollars.\nQ: next question 99", "gsm8k"
+    ) == "12"
+    assert extract_gsm_few_shot_cot_answer("q", "no digits here", "gsm8k") \
+        == "[invalid]"
+
+
+def test_extract_sat_choice():
+    from nanorlhf_tpu.rewards.answer_extraction import extract_sat_few_shot_answer
+
+    assert extract_sat_few_shot_answer(
+        "q", "Therefore the final answer is (B).", "sat"
+    ) == "B"
+    assert extract_sat_few_shot_answer(
+        "q", "the final answer is c", "sat"
+    ) == "C"
+    assert extract_sat_few_shot_answer("q", "no choice given", "sat") \
+        == "placeholder"
+
+
+def test_extract_ocwcourses():
+    from nanorlhf_tpu.rewards.answer_extraction import (
+        extract_ocwcourses_few_shot_answer,
+    )
+
+    assert extract_ocwcourses_few_shot_answer(
+        "q", "Thus the final answer is 42. I hope it is correct.", "ocw"
+    ) == "42"
+    assert extract_ocwcourses_few_shot_answer("q", "nothing", "ocw") == "[invalid]"
+
+
+def test_extract_cmath_and_gaokao():
+    from nanorlhf_tpu.rewards.answer_extraction import (
+        extract_agieval_gaokao_mathcloze_few_shot_cot_test,
+        extract_cmath_few_shot_test,
+    )
+
+    assert extract_cmath_few_shot_test("q", "所以答案是 42。", "cmath") == "42"
+    assert extract_agieval_gaokao_mathcloze_few_shot_cot_test(
+        "q", "答案是$\\frac{1}{2}$", "gaokao"
+    ) == ["\\frac{1}{2}"]
+
+
+def test_extractor_registry_dispatch():
+    """`get_extractor(task)` — the per-benchmark dispatch the reference keys
+    its eval scripts on (eval_script.py:6-44 consumes these extractions)."""
+    from nanorlhf_tpu.rewards.answer_extraction import get_extractor
+
+    assert get_extractor("math")("q", "\\boxed{7}", "math") == ["7"]
+    assert get_extractor("gsm8k")("q", "= 3 apples", "gsm8k") == "3"
+    assert get_extractor("sat-math")("q", "the final answer is (a)", "sat") == "A"
+    assert get_extractor("unknown-task")("q", "The answer is 5", "t") == "5"
+
+
+def test_neq_relation():
+    """\\neq routes into its own branch — '=' splitting must not turn 'x!'
+    into factorial(x)."""
+    assert math_answers_equal("5\\neq x", "x \\neq 5") is True
+    assert math_answers_equal("x \\neq 5", "x \\neq 6") is False
+    assert math_answers_equal("x \\neq 5", "x = 5") is False
+
+
+def test_extractor_name_normalization():
+    from nanorlhf_tpu.rewards.answer_extraction import (
+        extract_gsm_few_shot_cot_answer,
+        extract_math_answer,
+        get_extractor,
+    )
+
+    assert get_extractor("MATH500") is extract_math_answer
+    assert get_extractor("math-500") is extract_math_answer
+    assert get_extractor("gsm8k_test") is extract_gsm_few_shot_cot_answer

@@ -74,3 +74,36 @@ def test_bucketing_module_dispatches_to_native(lib_available):
 
     lengths = [5, 1, 9, 2, 2, 7]
     assert create_batches(lengths, 12) == python_create_batches(lengths, 12)
+
+
+class _PicklableWordTokenizer:
+    """Module-level (picklable) tokenizer for the multiprocess-encode test."""
+
+    pad_token_id = 0
+
+    def encode(self, text):
+        return [3 + (len(w) % 50) for w in text.split()]
+
+
+def test_encode_texts_parallel_matches_serial():
+    """dataset.map(num_proc) parity: the fork-pool path returns byte-identical
+    ids to the serial path, in order."""
+    from nanorlhf_tpu.data.datasets import encode_texts
+
+    tok = _PicklableWordTokenizer()
+    texts = [f"word {'x' * (i % 13)} sample {i}" for i in range(400)]
+    serial = [tok.encode(t)[:8] for t in texts]
+    parallel = encode_texts(tok, texts, 8, num_proc=4)
+    assert parallel == serial
+
+
+def test_encode_texts_toy_tokenizer_keeps_decode_cache():
+    """ToyTokenizer opts out of the pool (parallel_safe=False) so its decode
+    cache fills in-process — round-tripping still works."""
+    from nanorlhf_tpu.data.datasets import encode_texts
+    from nanorlhf_tpu.data.tokenizer import ToyTokenizer
+
+    tok = ToyTokenizer(512)
+    texts = [f"alpha beta gamma{i}" for i in range(200)]
+    ids = encode_texts(tok, texts, 16, num_proc=4)
+    assert tok.decode(ids[0]).startswith("alpha beta")

@@ -151,3 +151,39 @@ def test_topk_sampling_distribution_small_vocab():
     )(keys))[:, 0]
     counts = np.bincount(toks, minlength=8) / len(toks)
     np.testing.assert_allclose(counts, probs, atol=0.03)
+
+
+def test_capture_logprobs_match_scoring_pass(tiny):
+    """Sampler-captured logprobs equal the scoring pass's
+    `logprobs_from_logits` at the response positions (f32 tiny model — the
+    two paths share the same math, so agreement is tight)."""
+    from nanorlhf_tpu.core import padded_forward_logits
+    from nanorlhf_tpu.ops.masking import logprobs_from_logits
+
+    config, params = tiny
+    ids, mask = _left_pad([[5, 6, 7], [8, 9]], 4)
+    T = 6
+    temp = 0.9
+    out, lp = generate(
+        params, config, ids, mask, jax.random.PRNGKey(5),
+        SamplingParams(temperature=temp, top_p=0.95, n=2, max_tokens=T,
+                       capture_logprobs=True),
+        eos_token_id=EOS, pad_token_id=PAD,
+    )
+    out, lp = np.asarray(out), np.asarray(lp)
+    assert out.shape == (4, T) and lp.shape == (4, T)
+
+    # de-pad the prompt rows like the trainer does and rescore
+    ids_rep = np.asarray(jnp.repeat(ids, 2, axis=0))
+    qr = np.concatenate([ids_rep, out], axis=1)
+    logits = padded_forward_logits(params, config, jnp.asarray(qr), PAD,
+                                   response_context_length=ids.shape[1])
+    scored = np.asarray(logprobs_from_logits(logits, jnp.asarray(out), temp))
+    # compare on real (pre-EOS) tokens only; positions after EOS hold pads
+    for b in range(out.shape[0]):
+        for t in range(T):
+            if out[b, t] == PAD:
+                break
+            assert abs(lp[b, t] - scored[b, t]) < 1e-3, (b, t, lp[b, t], scored[b, t])
+            if out[b, t] == EOS:
+                break

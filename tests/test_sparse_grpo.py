@@ -91,3 +91,32 @@ def test_sparse_grpo_all_zero_rewards_skips_update(tmp_path):
     trainer = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, reward)
     state = trainer.train()  # all updates skipped, but loop completes
     assert state["episode"] == 8
+
+
+def test_sparse_grpo_sampler_capture(tmp_path):
+    """Capture path in the sparse trainer: policy scoring skipped, drift
+    metric emitted, update trains."""
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    dataset = build_prompt_dataset(synthetic_math_corpus(32), tok, max_prompt_len=16)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO, output_dir=str(tmp_path / "cap"), response_length=8,
+        temperature=1.0, sample_n=2, total_episodes=16,
+        per_device_train_batch_size=1, gradient_accumulation_steps=1,
+        num_mini_batches=1, use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False, mesh=MeshConfig(-1, 1, 1), save_steps=0,
+    )
+    cfg.sampler_logprob_capture = True
+    rng = np.random.default_rng(0)
+
+    def noisy_reward(pmt_and_responses, responses_ids, tokenizer):
+        return rng.random(len(pmt_and_responses)).astype(np.float32)
+
+    trainer = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, noisy_reward)
+    trainer.train(num_updates=1)
+    lines = [json.loads(l) for l in open(tmp_path / "cap" / "metrics.jsonl")
+             if "sparse/kept_frac" in l]
+    m = lines[-1]
+    assert "sampler_capture/ratio_drift_new" in m
+    assert m["sampler_capture/ratio_drift_new"] < 1e-2

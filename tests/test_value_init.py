@@ -33,3 +33,41 @@ def test_value_init_runs_and_learns():
     assert not np.allclose(np.asarray(out["score"]), np.asarray(before))
     v = score_forward(out, mcfg, jnp.asarray(ds.input_ids[:2]), tok.pad_token_id)
     assert bool(jnp.all(jnp.isfinite(v)))
+
+
+def test_value_init_lora_partition_freezes_backbone():
+    """With value_lora_cfg the regression touches ONLY score + adapters +
+    embed; the backbone (layers, norm) is bit-identical after training."""
+    from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params
+
+    tok = ToyTokenizer(256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    policy = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    ref = jax.tree.map(jnp.copy, policy)
+    vcfg_lora = LoraConfig(r=4, alpha=8)
+    value = jax.tree.map(
+        jnp.copy, {k: v for k, v in policy.items() if k != "lm_head"}
+    )
+    value["score"] = init_score_head(mcfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    value["lora"] = init_lora_params(mcfg, vcfg_lora, jax.random.PRNGKey(2),
+                                     dtype=jnp.float32)
+
+    def reward(prs, eos):
+        return np.asarray([1.0 if eos in s else -0.5 for s in prs], np.float32)
+
+    ds = load_prompt_dataset("synthetic:24", tok, max_prompt_len=10)
+    backbone_before = [np.asarray(x).copy() for x in jax.tree.leaves(value["layers"])]
+    score_before = np.asarray(value["score"]).copy()
+    out = finetune_value_model(
+        value, policy, ref, reward, np.asarray(ds.input_ids), tok, mcfg,
+        response_length=6, temperature=1.0, kl_coef=0.05, gamma=1.0,
+        vcfg=ValueInitConfig(train_data_size=24, num_train_epochs=2,
+                             per_device_train_batch_size=4),
+        value_lora_cfg=vcfg_lora,
+    )
+    for a, b in zip(backbone_before, jax.tree.leaves(out["layers"])):
+        np.testing.assert_array_equal(a, np.asarray(b))  # frozen
+    assert not np.allclose(np.asarray(out["score"]), score_before)  # trained
+    assert any(
+        float(jnp.abs(x).sum()) > 0 for x in jax.tree.leaves(out["lora"])
+    )
