@@ -260,7 +260,11 @@ def get_extractor(task: str):
             return fn
     import logging
 
-    logging.getLogger("nanorlhf_tpu.rewards").info(
-        "no benchmark extractor for task %r; using last-answer fallback", task
+    from nanorlhf_tpu.utils.logging import warn_once
+
+    warn_once(
+        "nanorlhf_tpu.rewards",
+        "no benchmark extractor for task %r; using last-answer fallback",
+        task, level=logging.INFO,
     )
     return extract_last_single_answer

@@ -234,17 +234,28 @@ def _digit_value(raw: str):
     return v / 100.0 if pct else v
 
 
-def _digits_equal(pred_raw: str, gt_raw: str) -> bool | None:
-    """Reference numeric rule (`eval_utils.math_equal:195-214`): compare pred
-    against {gt/100, gt, gt*100} with abs_tol 1e-3 — percentage-robust."""
+def _digits_equal(
+    pred_raw: str, gt_raw: str, percent_variants: bool = False
+) -> bool | None:
+    """Numeric comparison with abs_tol 1e-3. With ``percent_variants``, pred
+    is also compared against {gt/100, gt*100} (`eval_utils.math_equal:195-214`
+    — the reference applies this leniency in its OFFLINE EVAL toolkit only).
+    Without it, the x100 variants are accepted only when either side carries
+    an explicit '%': a LIVE TRAINING reward that accepted '0.5' for '50'
+    unconditionally would be a reward-hacking surface the reference's
+    training-path grader (`grpo_r1.py:216-224`) does not have."""
     import math
 
     pv, gv = _digit_value(pred_raw), _digit_value(gt_raw)
     if pv is None or gv is None:
         return None
+    lenient = percent_variants or any(
+        "%" in s or "\\%" in s or "percent" in s.lower()
+        for s in (pred_raw, gt_raw)
+    )
+    variants = (gv / 100.0, gv, gv * 100.0) if lenient else (gv,)
     return any(
-        math.isclose(pv, g, rel_tol=1e-9, abs_tol=1e-3)
-        for g in (gv / 100.0, gv, gv * 100.0)
+        math.isclose(pv, g, rel_tol=1e-9, abs_tol=1e-3) for g in variants
     )
 
 
@@ -342,11 +353,16 @@ def _equation_equal(a: str, b: str) -> bool | None:
     return None
 
 
-def math_answers_equal(pred: str, gt: str) -> bool:
+def math_answers_equal(
+    pred: str, gt: str, percent_variants: bool = False
+) -> bool:
     """Equivalence ladder, reference-toolkit breadth (VERDICT r1 #4):
-    string → percentage-robust numeric → \\cup unions → matrices →
-    intervals/tuples → relations/equations → normalized → \\pm branches →
-    numeric → sympy symbolic (with numeric-closeness fallback).
+    string → numeric (percent-aware; x100 variants only when a '%' marker
+    appears or ``percent_variants`` is set — eval paths pass True for
+    `eval_utils.math_equal` parity, training rewards stay strict) →
+    \\cup unions → matrices → intervals/tuples → relations/equations →
+    normalized → \\pm branches → numeric → sympy symbolic (with
+    numeric-closeness fallback).
     No subprocess here — wrap in call_with_timeout for that.
     """
     if pred is None or gt is None:
@@ -354,18 +370,23 @@ def math_answers_equal(pred: str, gt: str) -> bool:
     if pred.strip() == gt.strip():
         return True
 
-    # numeric with the reference's percentage variants, on the RAW strings
-    # (normalization strips '%', which must influence the value first)
-    num = _digits_equal(pred, gt)
+    # numeric first, on the RAW strings (normalization strips '%', which
+    # must influence the value first)
+    num = _digits_equal(pred, gt, percent_variants=percent_variants)
     if num is not None:
         return num
 
     a_s, b_s = _light_clean(pred), _light_clean(gt)
-    # set unions: piecewise comparison (`eval_script.is_correct:28-33`)
+    # set unions: order-free bipartite coverage of the pieces, matching
+    # `eval_script.is_correct:28-33` (which recurses into the list path)
     if "\\cup" in a_s or "\\cup" in b_s:
         pa, pb = a_s.split("\\cup"), b_s.split("\\cup")
-        return len(pa) == len(pb) and all(
-            math_answers_equal(x, y) for x, y in zip(pa, pb)
+        if len(pa) != len(pb):
+            return False
+        return all(
+            any(math_answers_equal(x, y) for y in pb) for x in pa
+        ) and all(
+            any(math_answers_equal(x, y) for x in pa) for y in pb
         )
     # matrices: rows by \\\\, columns by &, env type ignored
     # (`eval_utils.math_equal:233-253`)

@@ -111,25 +111,6 @@ class SparseGRPOTrainer(RLTrainer):
         self._bucket_score_cached = score
         return score
 
-    def _bucket_ref_score_fn(self):
-        """Ref-only bucket scorer (sampler-logprob-capture path)."""
-        if hasattr(self, "_bucket_ref_cached"):
-            return self._bucket_ref_cached
-        mcfg, cfg = self.mcfg, self.cfg
-        pad_id = self.tokenizer.pad_token_id
-
-        @partial(jax.jit, static_argnums=(2,))
-        def score_ref(ref_params, qr, context_length: int):
-            resp = qr[:, context_length:]
-            return logprobs_from_logits(
-                padded_forward_logits(ref_params, mcfg, qr, pad_id,
-                                      response_context_length=context_length),
-                resp, cfg.temperature,
-            )
-
-        self._bucket_ref_cached = score_ref
-        return score_ref
-
     def _sp_ref_score_fn(self):
         if hasattr(self, "_sp_ref_cached"):
             return self._sp_ref_cached
@@ -329,7 +310,7 @@ class SparseGRPOTrainer(RLTrainer):
 
         capture = cfg.sampler_logprob_capture
         ref_fn = (
-            (self._sp_ref_score_fn() if sp_on else self._bucket_ref_score_fn())
+            (self._sp_ref_score_fn() if sp_on else self._ref_score_fn())
             if capture else None
         )
         sampling = SamplingParams(

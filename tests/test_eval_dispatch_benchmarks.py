@@ -1,0 +1,117 @@
+"""Per-benchmark evaluators vs the reference eval script
+(`/root/reference/examples/r1-v0/utils/eval/eval_script.py:46-172`)."""
+
+import pytest
+
+from nanorlhf_tpu.rewards.eval_dispatch import (
+    eval_agieval_gaokao_math_cloze,
+    eval_agieval_gaokao_mathqa,
+    eval_last_single_answer,
+    eval_math,
+    eval_math_sat,
+    eval_minif2f_isabelle,
+    eval_mmlu_stem,
+    eval_ocwcourses,
+    get_evaluator,
+    is_correct_item,
+)
+
+
+class TestEvalMath:
+    def test_dedups_gold_and_truncates_pred(self):
+        # gold repeats; model boxed a stray value before the real answers
+        assert eval_math(["7", "2", "3"], ["2", "3", "3"])
+
+    def test_order_free_multi_answer(self):
+        assert eval_math(["3", "2"], ["2", "3"])
+
+    def test_missing_part_fails(self):
+        assert not eval_math(["2"], ["2", "3"])
+
+    def test_scalar_pred_promoted(self):
+        assert eval_math("4", ["4"])
+
+
+class TestGaokaoCloze:
+    def test_bracket_aware_split(self):
+        # the ',' inside (1,2) must NOT split; the ';' must
+        assert eval_agieval_gaokao_math_cloze(["(1,2); 5"], ["(1,2)", "5"])
+
+    def test_order_matters(self):
+        assert not eval_agieval_gaokao_math_cloze(["5; (1,2)"], ["(1,2)", "5"])
+
+    def test_keeps_last_n_parts(self):
+        assert eval_agieval_gaokao_math_cloze(["9; 1; 2"], ["1", "2"])
+
+    def test_scalar_answer_wraps(self):
+        # len() on a raw string would count characters and zero-score it
+        assert eval_agieval_gaokao_math_cloze("12", "12")
+
+
+class TestGaokaoMathQA:
+    def test_latest_first_occurrence_wins(self):
+        # 'B' first occurs after 'A' first occurs → B is the chosen tag
+        assert eval_agieval_gaokao_mathqa(["A is wrong, B is right"], "B")
+
+    def test_single_letter(self):
+        assert eval_agieval_gaokao_mathqa(["C"], "C")
+
+    def test_no_letter_fails(self):
+        assert not eval_agieval_gaokao_mathqa(["no idea"], "A")
+
+
+class TestChoiceLetters:
+    def test_sat_case_insensitive(self):
+        assert eval_math_sat("b", "B")
+        assert not eval_math_sat("A", "B")
+
+    def test_mmlu_is_sat(self):
+        assert eval_mmlu_stem is eval_math_sat
+
+    def test_sat_coerces_list_to_last_element(self):
+        # extractors return lists; a mislabeled row must score, not crash
+        assert eval_math_sat(["C", "A"], "a")
+        assert not eval_math_sat([], "A")
+
+
+class TestOCW:
+    def test_numeric_with_units(self):
+        assert eval_ocwcourses("3.0 m/s", "3")
+
+    def test_numeric_one_percent_threshold(self):
+        assert eval_ocwcourses("100.0000001", "100")
+        assert not eval_ocwcourses("102", "100")
+
+    def test_exact_zero_and_negative_grade_correct(self):
+        # the reference's mean-relative carve-out grades these False
+        assert eval_ocwcourses("0", "0")
+        assert eval_ocwcourses("-5", "-5")
+        assert eval_ocwcourses("-5.00000001", "-5")
+
+    def test_scientific_notation(self):
+        assert eval_ocwcourses("3 \\times 10^{4}", "30000")
+
+    def test_equation_equivalence(self):
+        assert eval_ocwcourses("y = x + 1", "y = 1 + x")
+        assert not eval_ocwcourses("y = x + 2", "y = x + 1")
+
+    def test_expression_equivalence(self):
+        assert eval_ocwcourses("\\frac{1}{2}", "0.5")
+
+    def test_empty_pred_fails(self):
+        assert not eval_ocwcourses("", "3")
+
+
+def test_minif2f_always_true():
+    assert eval_minif2f_isabelle("anything", "placeholder")
+
+
+def test_gsm_scalar_and_list_coercion():
+    assert eval_last_single_answer("72", "72")
+    assert eval_last_single_answer(["8", "72"], "72")  # last element wins
+
+
+def test_registry_dispatch_and_fallback():
+    assert get_evaluator("MATH-COT") is eval_math
+    assert get_evaluator("ocw") is eval_ocwcourses
+    assert get_evaluator("unknown-benchmark") is is_correct_item

@@ -16,11 +16,12 @@ from nanorlhf_tpu.rewards.math_grader import is_correct, math_answers_equal
 
 # (prediction, ground truth, expected verdict)
 EQUIV_GOLDEN = [
-    # --- percentage variants (eval_utils.math_equal include_percentage) ---
+    # --- percentage variants (eval_utils.math_equal include_percentage;
+    #     bare x100 variants with NO % marker are eval-path-only leniency,
+    #     tested separately in test_grader_strictness.py) ---
     ("50", "50\\%", True),
     ("0.5", "50\\%", True),
     ("50%", "0.5", True),
-    ("0.17", "17", True),          # 17/100 variant
     ("3", "5\\%", False),
     # --- numeric closeness (abs_tol 1e-3 digits; rel_tol 1e-3 symbolic) ---
     ("0.333", "\\frac{1}{3}", True),

@@ -1,0 +1,48 @@
+"""Training-reward strictness vs offline-eval leniency.
+
+The reference's x100/÷100 percentage leniency lives ONLY in its offline eval
+toolkit (`eval_utils.math_equal:195-214`); its training-path grader
+(`grpo_r1.py:216-224`) has no such rule. A live reward that accepted '0.5'
+for '50' unconditionally would be a reward-hacking surface.
+"""
+
+from nanorlhf_tpu.rewards.eval_dispatch import is_correct_item
+from nanorlhf_tpu.rewards.math_grader import is_correct, math_answers_equal
+
+
+class TestTrainingRewardStrict:
+    def test_x100_variants_rejected_without_percent_marker(self):
+        assert not math_answers_equal("0.5", "50")
+        assert not math_answers_equal("50", "0.5")
+        assert not math_answers_equal("1234", "12.34")
+
+    def test_percent_marker_enables_variants(self):
+        assert math_answers_equal("50%", "0.5")
+        assert math_answers_equal("0.5", "50\\%")
+
+    def test_is_correct_training_path_strict(self):
+        # is_correct grades the EXTRACTED boxed answer (`grpo_r1.py:216-224`)
+        assert not is_correct("0.5", "50", use_subprocess=False)
+        assert is_correct("50", "50", use_subprocess=False)
+
+    def test_is_correct_strict_through_subprocess_guard(self):
+        assert not is_correct("0.5", "50", timeout=5.0)
+        assert not is_correct("0.17", "17", timeout=5.0)
+
+
+class TestEvalPathLenient:
+    def test_eval_dispatch_accepts_x100_variants(self):
+        # reference eval parity: math_equal compares vs {gt/100, gt, gt*100}
+        assert is_correct_item("0.5", "50")
+        assert is_correct_item("50", "0.5")
+
+
+class TestCupUnionOrderFree:
+    def test_union_pieces_match_in_any_order(self):
+        a = "(1,2)\\cup(3,4)"
+        b = "(3,4)\\cup(1,2)"
+        assert math_answers_equal(a, b)
+        assert is_correct_item(a, b)
+
+    def test_union_count_mismatch_fails(self):
+        assert not math_answers_equal("(1,2)\\cup(3,4)", "(1,2)")
