@@ -100,6 +100,30 @@ def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
     return None, (out.stderr or out.stdout).strip()[-800:]
 
 
+_RELAY_PORTS = (8082, 8092, 8102, 8112)  # axon loopback-relay listen ports
+
+
+def _tunnel_alive() -> bool | None:
+    """Preflight for the axon TPU tunnel. None = not an axon env (no
+    preflight possible); True = a relay port accepts connections; False =
+    every port refuses — the relay process is dead and the axon client
+    would retry-dial it FOREVER (observed: a dead relay turned each bench
+    attempt into a full attempt-timeout burn; a 5 s socket check answers
+    the same question)."""
+    if os.environ.get("JAX_PLATFORMS") != "axon":
+        return None
+    import socket
+
+    for port in _RELAY_PORTS:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            s.close()
+            return True
+        except OSError:
+            continue
+    return False
+
+
 def orchestrate() -> int:
     """Parent entry: spawn children with retry/backoff, emit ONE JSON line."""
     attempts = int(os.environ.get("BENCH_ATTEMPTS", 2))
@@ -108,6 +132,14 @@ def orchestrate() -> int:
 
     errors = []
     for attempt in range(attempts):
+        if _tunnel_alive() is False:
+            errors.append(
+                f"attempt {attempt + 1}: axon relay not listening on "
+                f"{_RELAY_PORTS} — TPU tunnel down, skipping TPU attempt"
+            )
+            print(f"[bench] {errors[-1]}", file=sys.stderr)
+            time.sleep(min(20 * (attempt + 1), 60))
+            continue
         payload, err = _run_child({}, timeout_s)
         if payload is not None:
             _emit(payload)

@@ -188,14 +188,18 @@ def process_agieval_gaokao_mathqa(item: dict) -> Iterator[Sample]:
     options = []
     for option in item["options"]:
         option = option.strip()
-        if not (option[0] == "(" and option[2] == ")" and option[1] in "ABCD"):
+        if len(option) < 4 or not (
+            option[0] == "(" and option[2] == ")" and option[1] in "ABCD"
+        ):
             raise ValueError(f"malformed gaokao option: {option[:10]!r}")
         options.append(f"{option[1]}: {option[3:].strip()}")
+    # the reference interpolates the Python list (its prompt literally shows
+    # "['A: 1', ...]", `process_utils.py:133`) — joined cleanly here
     yield {
         "dataset": "agieval-gaokao-mathqa",
         "id": item["id"],
         "messages": [
-            {"role": "user", "content": f"{question}\n{options}"},
+            {"role": "user", "content": f"{question}\n{' '.join(options)}"},
             {"role": "assistant", "content": ""},
         ],
         "answer": item["label"],

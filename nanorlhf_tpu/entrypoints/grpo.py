@@ -8,7 +8,10 @@ from nanorlhf_tpu.entrypoints.common import run
 from nanorlhf_tpu.trainer import AlgoName, RLConfig
 
 
-def build_config() -> RLConfig:
+def build_config(sequence_parallel: int = 1) -> RLConfig:
+    """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
+    update through ring attention with the sequence dim sharded over an sp
+    mesh axis (response_length must divide by it)."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-v1",
@@ -43,6 +46,10 @@ def build_config() -> RLConfig:
         load_best_model_at_end=True,
         stop_token="eos",
     )
+    if sequence_parallel > 1:
+        from nanorlhf_tpu.parallel import MeshConfig
+
+        cfg.mesh = MeshConfig(data=-1, sp=sequence_parallel)
     return cfg
 
 

@@ -168,6 +168,7 @@ def sp_score_logprobs(
     sp_axis: str = "sp",
     fsdp_axis: str | None = None,
     lora_scale: float = 1.0,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Per-position next-token logprobs [B, T] under sequence parallelism —
     the scoring primitive for beyond-one-device contexts (the RL logprob
@@ -178,7 +179,9 @@ def sp_score_logprobs(
     right neighbor's first token via ppermute. Callers slice
     `[:, ctx-1:T-1]` for response logprobs exactly as in the single-device
     path. `fsdp_axis` switches the underlying forward to the
-    params-sharded-at-rest variant.
+    params-sharded-at-rest variant. `remat` checkpoints per-layer activations
+    — pass the trainer's gradient_checkpointing when differentiating through
+    this (scoring-only callers can leave it off).
     """
     from nanorlhf_tpu.core.model import padding_inputs
     from nanorlhf_tpu.ops.masking import logprobs_from_logits
@@ -201,7 +204,7 @@ def sp_score_logprobs(
 
         def fn(params_local, ids, mask, pos):
             logits = _sp_fsdp_forward_local(
-                config, specs, sp_axis, fsdp_axis, lora_scale, False,
+                config, specs, sp_axis, fsdp_axis, lora_scale, remat,
                 params_local, ids, mask, pos,
             )
             return local_score(logits, ids)
@@ -216,7 +219,7 @@ def sp_score_logprobs(
         def fn(ids, mask, pos):
             logits = _sp_forward_local(
                 params, config, ids, mask, pos,
-                axis_name=sp_axis, lora_scale=lora_scale, remat=False,
+                axis_name=sp_axis, lora_scale=lora_scale, remat=remat,
             )
             return local_score(logits, ids)
 

@@ -211,11 +211,12 @@ def _ocw_normalize_numeric(s: str):
 
 
 def _ocw_numeric_equality(n1: float, n2: float, threshold: float = 0.01) -> bool:
-    """Relative closeness with a near-zero carve-out
-    (`ocwcourses_eval_utils.numeric_equality:69-75`). Unlike the reference,
-    exact equality always passes (its mean-relative carve-out grades 0 == 0
-    and negative pairs False — `abs(n1-n2) < threshold*(n1+n2)/2` is never
-    true for a zero or negative mean)."""
+    """Reference parity (`ocwcourses_eval_utils.numeric_equality:69-75`):
+    rel_tol 1e-5 closeness on the main path; `threshold` (1% of the mean)
+    applies only in the near-zero carve-out. Unlike the reference, exact
+    equality always passes (its carve-out grades 0 == 0 and negative pairs
+    False — `abs(n1-n2) < threshold*(n1+n2)/2` is never true for a zero or
+    negative mean)."""
     import math
 
     if n1 == n2:
@@ -250,9 +251,10 @@ def _ocw_normalize_equation(s: str):
 
 
 def eval_ocwcourses(pred, answer, prec: float = 1e-3) -> bool:
-    """OCW: answer type decides the grader — numeric (unit-stripped, 1%
-    threshold), equation (canonical sympy Equality), or tex expression
-    (normalize + symbolic equivalence) (`eval_script.py:134-170`)."""
+    """OCW: answer type decides the grader — numeric (unit-stripped, rel_tol
+    1e-5 with a 1%-of-mean near-zero carve-out), equation (canonical sympy
+    Equality), or tex expression (normalize + symbolic equivalence)
+    (`eval_script.py:134-170`)."""
     pred, answer = _last_str(pred), _last_str(answer)
     if not pred:
         return False

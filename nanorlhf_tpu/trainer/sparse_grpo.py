@@ -111,25 +111,6 @@ class SparseGRPOTrainer(RLTrainer):
         self._bucket_score_cached = score
         return score
 
-    def _sp_ref_score_fn(self):
-        if hasattr(self, "_sp_ref_cached"):
-            return self._sp_ref_cached
-        from nanorlhf_tpu.parallel.sp import sp_score_logprobs
-
-        mcfg, cfg, mesh = self.mcfg, self.cfg, self.mesh
-        pad_id = self.tokenizer.pad_token_id
-        fsdp_axis = self._fsdp_axis()
-
-        @partial(jax.jit, static_argnums=(2,))
-        def score_ref(ref_params, qr, context_length: int):
-            return sp_score_logprobs(
-                ref_params, mcfg, qr, pad_id, cfg.temperature, mesh,
-                fsdp_axis=fsdp_axis,
-            )[:, context_length - 1 : -1]
-
-        self._sp_ref_cached = score_ref
-        return score_ref
-
     def _bucket_grad_fn(self):
         if hasattr(self, "_bucket_grad_cached"):
             return self._bucket_grad_cached
@@ -169,20 +150,11 @@ class SparseGRPOTrainer(RLTrainer):
         return bucket_grads
 
     # ------------------------------------------------------------------ #
-    # sequence-parallel pieces (mesh sp > 1): the 8k-token path beyond one
-    # device — logprob scoring and the update forward run through ring
-    # attention with the sequence dim sharded over the sp axis
-    # (VERDICT r1 #3: SP is now a trainer capability, not a demo)
+    # sequence-parallel pieces (mesh sp > 1): bucket-shaped SP scoring and
+    # grads — `_sp_on`/`_fsdp_axis` come from RLTrainer, which also runs
+    # its own dense chunked passes through SP when the axis is present
+    # (VERDICT r1 #3: SP is a trainer capability, not a demo)
     # ------------------------------------------------------------------ #
-
-    def _sp_on(self) -> bool:
-        on = self.mesh.shape.get("sp", 1) > 1
-        if on and self.mesh.shape.get("tensor", 1) > 1:
-            raise ValueError("sp > 1 with tensor > 1 is not supported")
-        return on
-
-    def _fsdp_axis(self):
-        return "fsdp" if self.mesh.shape.get("fsdp", 1) > 1 else None
 
     def _sp_score_fn(self):
         if hasattr(self, "_sp_score_cached"):
@@ -225,7 +197,7 @@ class SparseGRPOTrainer(RLTrainer):
             new_lp = sp_score_logprobs(
                 tree["policy"], mcfg, mb["query_responses"], pad_id,
                 cfg.temperature, mesh, fsdp_axis=fsdp_axis,
-                lora_scale=lora_scale,
+                lora_scale=lora_scale, remat=cfg.gradient_checkpointing,
             )[:, context_length - 1 : -1]
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
             loss, aux = grpo_loss(
@@ -308,11 +280,9 @@ class SparseGRPOTrainer(RLTrainer):
             acc = float(self.accuracy_func(self))
             self.logger.log(0, 0, {"initial_accuracy": acc})
 
+        # _ref_score_fn itself branches to the SP scorer when sp is on
         capture = cfg.sampler_logprob_capture
-        ref_fn = (
-            (self._sp_ref_score_fn() if sp_on else self._ref_score_fn())
-            if capture else None
-        )
+        ref_fn = self._ref_score_fn() if capture else None
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,

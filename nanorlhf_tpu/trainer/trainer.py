@@ -309,23 +309,38 @@ class RLTrainer:
         grad_accum = cfg.gradient_accumulation_steps
 
         combine = self._combine
+        sp_on = self._sp_on()
+        sp_mesh, sp_fsdp_axis = self.mesh, self._fsdp_axis()
 
         def microbatch_loss(trainable, frozen, mb, context_length):
             train_tree = combine(trainable, frozen)
-            logits = padded_forward_logits(
-                train_tree["policy"], mcfg, mb["query_responses"], pad_id,
-                lora_scale=lora_scale, remat=remat,
-                response_context_length=context_length,
-            )
-            # true update-pass entropy over the temperature-scaled logits —
-            # `policy/entropy_avg_new`, unmasked mean like the reference
-            # (`GRPO/grpo_trainer.py:679-687`)
-            entropy = jax.lax.stop_gradient(entropy_from_logits(
-                logits.astype(jnp.float32) / (cfg.temperature + 1e-7)
-            ).mean())
-            new_logprobs = logprobs_from_logits(
-                logits, mb["responses"], cfg.temperature
-            )
+            if sp_on:
+                from nanorlhf_tpu.parallel.sp import sp_score_logprobs
+
+                # ring-attention sequence-parallel forward; the global
+                # [B, T, V] logits never materialize, so the entropy stat
+                # is unavailable (0.0) on this path — same as SparseGRPO's
+                new_logprobs = sp_score_logprobs(
+                    train_tree["policy"], mcfg, mb["query_responses"], pad_id,
+                    cfg.temperature, sp_mesh, fsdp_axis=sp_fsdp_axis,
+                    lora_scale=lora_scale, remat=remat,
+                )[:, context_length - 1 : -1]
+                entropy = jnp.float32(0.0)
+            else:
+                logits = padded_forward_logits(
+                    train_tree["policy"], mcfg, mb["query_responses"], pad_id,
+                    lora_scale=lora_scale, remat=remat,
+                    response_context_length=context_length,
+                )
+                # true update-pass entropy over the temperature-scaled logits
+                # — `policy/entropy_avg_new`, unmasked mean like the
+                # reference (`GRPO/grpo_trainer.py:679-687`)
+                entropy = jax.lax.stop_gradient(entropy_from_logits(
+                    logits.astype(jnp.float32) / (cfg.temperature + 1e-7)
+                ).mean())
+                new_logprobs = logprobs_from_logits(
+                    logits, mb["responses"], cfg.temperature
+                )
             new_logprobs = jnp.where(
                 mb["padding_mask"], INVALID_LOGPROB, new_logprobs
             )
@@ -417,9 +432,43 @@ class RLTrainer:
             update_minibatch
         )
 
+    # ------------------------------------------------------------------ #
+    # sequence parallelism (mesh sp > 1): the logprob/score pass and the
+    # update forward run through ring attention with the sequence dim
+    # sharded over the sp axis — for BOTH this dense runtime and the
+    # SparseGRPOTrainer subclass (VERDICT r1 #3 / ROADMAP #7)
+    # ------------------------------------------------------------------ #
+
+    def _sp_on(self) -> bool:
+        on = self.mesh.shape.get("sp", 1) > 1
+        if on and self.mesh.shape.get("tensor", 1) > 1:
+            raise ValueError("sp > 1 with tensor > 1 is not supported")
+        if on and self.algo == AlgoName.PPO:
+            raise ValueError(
+                "sp > 1 is not supported for PPO yet (the value-head forward "
+                "has no sequence-parallel variant)"
+            )
+        return on
+
+    def _fsdp_axis(self):
+        return "fsdp" if self.mesh.shape.get("fsdp", 1) > 1 else None
+
+    def _sp_check_widths(self, context_length: int):
+        """The sequence dim shards evenly over the sp ring: every jitted
+        width (context, response, and their sum) must divide by sp."""
+        n_sp = self.mesh.shape.get("sp", 1)
+        for name, width in (("context", context_length),
+                            ("response_length", self.cfg.response_length)):
+            if width % n_sp != 0:
+                raise ValueError(
+                    f"{name} width {width} not divisible by sp={n_sp}; pick "
+                    f"prompt/response widths as multiples of sp"
+                )
+
     def _score_chunk_fn(self):
         """Jitted policy+ref logprob scorer for one rollout chunk (cached —
-        repeated train() calls must reuse the compiled executable)."""
+        repeated train() calls must reuse the compiled executable). With an
+        sp mesh axis the forwards run ring-attention sequence-parallel."""
         if hasattr(self, "_score_fn_cached"):
             return self._score_fn_cached
         mcfg, cfg = self.mcfg, self.cfg
@@ -427,6 +476,26 @@ class RLTrainer:
         lora_scale = self.lora_scale
 
         from functools import partial
+
+        if self._sp_on():
+            from nanorlhf_tpu.parallel.sp import sp_score_logprobs
+
+            mesh, fsdp_axis = self.mesh, self._fsdp_axis()
+
+            @partial(jax.jit, static_argnums=(3,))
+            def score(params, ref_params, query_responses, context_length: int):
+                lp = sp_score_logprobs(
+                    params, mcfg, query_responses, pad_id, cfg.temperature,
+                    mesh, fsdp_axis=fsdp_axis, lora_scale=lora_scale,
+                )[:, context_length - 1 : -1]
+                rlp = sp_score_logprobs(
+                    ref_params, mcfg, query_responses, pad_id, cfg.temperature,
+                    mesh, fsdp_axis=fsdp_axis,
+                )[:, context_length - 1 : -1]
+                return lp, rlp
+
+            self._score_fn_cached = score
+            return score
 
         @partial(jax.jit, static_argnums=(3,))
         def score(params, ref_params, query_responses, context_length: int):
@@ -455,6 +524,21 @@ class RLTrainer:
         pad_id = self.tokenizer.pad_token_id
 
         from functools import partial
+
+        if self._sp_on():
+            from nanorlhf_tpu.parallel.sp import sp_score_logprobs
+
+            mesh, fsdp_axis = self.mesh, self._fsdp_axis()
+
+            @partial(jax.jit, static_argnums=(2,))
+            def score_ref(ref_params, query_responses, context_length: int):
+                return sp_score_logprobs(
+                    ref_params, mcfg, query_responses, pad_id, cfg.temperature,
+                    mesh, fsdp_axis=fsdp_axis,
+                )[:, context_length - 1 : -1]
+
+            self._ref_score_cached = score_ref
+            return score_ref
 
         @partial(jax.jit, static_argnums=(2,))
         def score_ref(ref_params, query_responses, context_length: int):
@@ -507,6 +591,8 @@ class RLTrainer:
                 # cache) instead of the dataset-wide pad width
                 queries = depad_queries(queries, pad_id, ctx_menu)
             batch_size, context_length = queries.shape
+            if self._sp_on():
+                self._sp_check_widths(context_length)
             queries_j = jax.device_put(
                 jnp.asarray(queries), batch_sharding(self.mesh)
             )

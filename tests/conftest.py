@@ -49,11 +49,26 @@ def _host_fingerprint() -> str:
         return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
-_cache_dir = os.path.join(
+_cache_dir = os.path.abspath(os.path.join(
     os.path.dirname(__file__), "..", f".jax_cache_{_host_fingerprint()}"
-)
+))
+
+# Crash healing: a suite process that dies hard (SIGKILL mid-write, native
+# abort) can leave a corrupt cache entry that SIGABRTs every later run at
+# load time (observed). A sentinel marks a suite in progress; finding one at
+# startup means the previous run died mid-suite — wipe the cache and recompile
+# rather than abort forever.
+_sentinel = os.path.join(_cache_dir, ".suite_in_progress")
+if os.path.exists(_sentinel):
+    import shutil
+
+    shutil.rmtree(_cache_dir, ignore_errors=True)
+os.makedirs(_cache_dir, exist_ok=True)
+with open(_sentinel, "w") as _f:
+    _f.write(str(os.getpid()))
+
 try:
-    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
     # persist even sub-second compiles: tiny-model suites are made of them
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 except Exception:
@@ -61,6 +76,13 @@ except Exception:
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    try:
+        os.remove(_sentinel)
+    except OSError:
+        pass
 
 
 @pytest.fixture

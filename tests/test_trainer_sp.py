@@ -1,0 +1,161 @@
+"""Dense RLTrainer with sequence parallelism: chunked logprob scoring and
+the jitted update run through ring attention when the mesh has sp > 1
+(ROADMAP #7 remainder — SP for the non-sparse algorithms)."""
+
+import json
+import zlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+from nanorlhf_tpu.parallel import MeshConfig, make_mesh
+from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
+
+
+def det_reward(pmt_and_responses, eos_token):
+    return np.asarray(
+        [(zlib.crc32(s.encode()) % 17) / 17.0 for s in pmt_and_responses],
+        np.float32,
+    )
+
+
+def _make_trainer(tmp_path, name, mesh, algo=AlgoName.GRPO, **cfg_kw):
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    dataset = load_prompt_dataset("synthetic:32", tok, max_prompt_len=16)
+    defaults = dict(
+        algo=algo,
+        output_dir=str(tmp_path / name),
+        response_length=8,
+        temperature=1.0,
+        sample_n=2 if algo in (AlgoName.GRPO, AlgoName.RLOO) else 1,
+        kl_coef=0.05,
+        total_episodes=4,
+        per_device_train_batch_size=2,
+        gradient_accumulation_steps=1,
+        num_mini_batches=1,
+        learning_rate=1e-3,
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False,
+        save_steps=0,
+        report_to="jsonl",
+        logging_steps=1,
+    )
+    cfg = RLConfig(**{**defaults, **cfg_kw})
+    return RLTrainer(cfg, mcfg, tok, params, dataset, det_reward, mesh=mesh)
+
+
+def _lora_leaves(trainer):
+    return [np.asarray(x) for x in jax.tree.leaves(trainer.params["lora"])]
+
+
+def _metric_rows(outdir):
+    return [
+        json.loads(l) for l in open(outdir / "metrics.jsonl")
+        if "loss/policy_avg_new" in l
+    ]
+
+
+def test_dense_sp2_matches_single_device(tmp_path):
+    devs = jax.devices()
+    ctrl = _make_trainer(
+        tmp_path, "ctrl", make_mesh(MeshConfig(1, 1, 1, 1), devices=devs[:1])
+    )
+    sp = _make_trainer(
+        tmp_path, "sp2", make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2])
+    )
+    assert sp._sp_on() and not ctrl._sp_on()
+    s1 = ctrl.train()
+    s2 = sp.train()
+    assert s1["global_step"] == s2["global_step"] == 2
+
+    # same PRNG stream + deterministic reward -> identical rollouts; ring
+    # attention only reorders f32 reductions -> params agree to bf16 slack
+    for a, b in zip(_lora_leaves(ctrl), _lora_leaves(sp)):
+        np.testing.assert_allclose(
+            a.astype(np.float32), b.astype(np.float32), rtol=5e-3, atol=2e-3
+        )
+
+    m1 = _metric_rows(tmp_path / "ctrl")
+    m2 = _metric_rows(tmp_path / "sp2")
+    assert len(m1) == len(m2) >= 1
+    for a, b in zip(m1, m2):
+        assert abs(a["loss/policy_avg_new"] - b["loss/policy_avg_new"]) < 1e-3
+        assert abs(a["objective/kl_old"] - b["objective/kl_old"]) < 1e-3
+        # SP never materializes global logits: entropy stat reports 0.0
+        assert b["policy/entropy_avg_new"] == 0.0
+
+
+def test_dense_sp_reinforce_trains(tmp_path):
+    """Token-level PPO-clip path (REINFORCE) under sp=2 stays finite."""
+    devs = jax.devices()
+    tr = _make_trainer(
+        tmp_path, "sp_reinf",
+        make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
+        algo=AlgoName.REINFORCE, advantage_whiten=True,
+        # exercises the remat-through-shard_map path (sp + checkpointing)
+        gradient_checkpointing=True,
+    )
+    tr.train(num_updates=1)
+    m = _metric_rows(tmp_path / "sp_reinf")
+    assert m and np.isfinite(m[-1]["loss/policy_avg_new"])
+
+
+def test_dense_sp_capture_uses_sp_ref_scorer(tmp_path):
+    """sampler_logprob_capture under sp: only the ref half of scoring runs,
+    through the SP scorer; ratio-drift guard metric is emitted."""
+    devs = jax.devices()
+    tr = _make_trainer(
+        tmp_path, "sp_cap",
+        make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
+        sampler_logprob_capture=True,
+    )
+    tr.train(num_updates=1)
+    m = _metric_rows(tmp_path / "sp_cap")
+    assert m and "sampler_capture/ratio_drift_new" in m[-1]
+    assert np.isfinite(m[-1]["loss/policy_avg_new"])
+
+
+def test_ppo_with_sp_raises(tmp_path):
+    devs = jax.devices()
+    tok = ToyTokenizer(512)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    vparams = init_params(mcfg, jax.random.PRNGKey(1), jnp.float32)
+    vparams = {k: v for k, v in vparams.items() if k != "lm_head"}
+    vparams["score"] = jnp.zeros((mcfg.hidden_size, 1), jnp.float32)
+    dataset = load_prompt_dataset("synthetic:32", tok, max_prompt_len=16)
+    cfg = RLConfig(
+        algo=AlgoName.PPO,
+        output_dir=str(tmp_path / "ppo_sp"),
+        response_length=8,
+        total_episodes=4,
+        per_device_train_batch_size=2,
+        gradient_accumulation_steps=1,
+        num_mini_batches=1,
+        save_steps=0,
+    )
+    with pytest.raises(ValueError, match="PPO"):
+        RLTrainer(
+            cfg, mcfg, tok, params, dataset, det_reward,
+            value_params=vparams,
+            mesh=make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
+        )
+
+
+def test_sp_width_divisibility_enforced(tmp_path):
+    """response_length not divisible by sp raises with a clear message."""
+    devs = jax.devices()
+    tr = _make_trainer(
+        tmp_path, "sp_odd",
+        make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
+        response_length=7,
+    )
+    with pytest.raises(ValueError, match="divisible by sp"):
+        tr.train(num_updates=1)
