@@ -44,6 +44,11 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 NEG_INF = -1e30
+# TPU VREG tile: small per-row operands (mask, lse, delta) are carried
+# sublane-/lane-expanded so every BlockSpec satisfies Mosaic's (8, 128)
+# last-two-dims tiling rule on real hardware (interpret mode never checks).
+_SUBLANES = 8
+_LANES = 128
 
 
 def _interpret_default() -> bool:
@@ -107,8 +112,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                       # [Bq, Bk]
-        key_ok = mask_ref[0] > 0                        # [Bk]
-        s = jnp.where(key_ok[None, :], s, NEG_INF)
+        key_ok = mask_ref[0, :1, :] > 0                 # [1, Bk]
+        s = jnp.where(key_ok, s, NEG_INF)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -143,8 +148,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:, :1], 1e-30)            # fully-masked rows → 0/1
         out_ref[0, 0] = (acc_ref[:] / l).astype(out_ref.dtype)
-        # lse = m + log(l): the backward residual (P = exp(S − lse))
-        lse_ref[0, 0] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        # lse = m + log(l): the backward residual (P = exp(S − lse)).
+        # Stored lane-expanded [Bq, LANES] — Mosaic tiling requires the last
+        # two block dims be (8k, 128k)-aligned or span the array dim, so a
+        # [Bq]-vector output is not liftable on real TPU hardware.
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l), lse_ref.shape[2:]
+        )
 
 
 def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
@@ -160,8 +170,15 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
         causal=causal,
     )
-    mask_i32 = key_valid.astype(jnp.int32)
-    return pl.pallas_call(
+    # Mosaic tiling: the last two block dims must be (8, 128)-multiples or
+    # span the array dim. A [B, T] mask with (1, block_k) blocks violates the
+    # sublane rule, so the mask rides sublane-broadcast as [B, 8, T] (the
+    # same recipe as jax's reference TPU flash kernel's segment ids), and lse
+    # rides lane-expanded as [B, H, T, LANES].
+    mask8 = jnp.broadcast_to(
+        key_valid.astype(jnp.int32)[:, None, :], (B, _SUBLANES, T)
+    )
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_kv),
         in_specs=[
@@ -171,18 +188,19 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
                          memory_space=_VMEM),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h // G, j, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j),
+            pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, i, j: (b, 0, j),
                          memory_space=_VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, i, j: (b, h, i, 0),
                          memory_space=_VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((B, H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, T, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -190,7 +208,12 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, mask_i32)
+    )(q, k, v, mask8)
+    # lse stays lane-expanded [B, H, T, LANES]: it is only ever a backward
+    # residual, and the backward kernels read it in this layout — slicing to
+    # [B, H, T] here would just force a re-broadcast (a 128x HBM round trip)
+    # before the bwd pallas_calls.
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -223,13 +246,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]                     # [Bq, 1]
-        delta = delta_ref[0, 0][:, None]                 # [Bq, 1]
+        lse = lse_ref[0, 0][:, :1]                       # [Bq, 1]
+        delta = delta_ref[0, 0][:, :1]                   # [Bq, 1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        key_ok = mask_ref[0] > 0
-        s = jnp.where(key_ok[None, :], s, NEG_INF)
+        key_ok = mask_ref[0, :1, :] > 0                  # [1, Bk]
+        s = jnp.where(key_ok, s, NEG_INF)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -276,13 +299,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        key_ok = mask_ref[0] > 0
-        s = jnp.where(key_ok[None, :], s, NEG_INF)
+        key_ok = mask_ref[0, :1, :] > 0
+        s = jnp.where(key_ok, s, NEG_INF)
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -319,9 +342,15 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
     scale = 1.0 / (d ** 0.5)
     n_q = pl.cdiv(T, block_q)
     n_kv = pl.cdiv(T, block_k)
-    mask_i32 = key_valid.astype(jnp.int32)
+    # sublane-broadcast mask / lane-expanded lse+delta: see _flash_forward
+    # (lse arrives already lane-expanded from the forward)
+    mask8 = jnp.broadcast_to(
+        key_valid.astype(jnp.int32)[:, None, :], (B, _SUBLANES, T)
+    )
     # D_i = Σ_j dO·O — cheap elementwise+reduce, left to XLA fusion
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    lse_e = lse
+    delta_e = jnp.broadcast_to(delta[..., None], (B, H, T, _LANES))
 
     common_q_specs = dict(
         q=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
@@ -330,11 +359,12 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
                        memory_space=_VMEM),
         v=pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h // G, j, 0),
                        memory_space=_VMEM),
-        mask=pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j),
+        mask=pl.BlockSpec((1, _SUBLANES, block_k), lambda b, h, i, j: (b, 0, j),
                           memory_space=_VMEM),
         do=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
                         memory_space=_VMEM),
-        lse=pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i),
+        lse=pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, h, i, j: (b, h, i, 0),
                          memory_space=_VMEM),
     )
 
@@ -349,7 +379,7 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask_i32, g, lse, delta)
+    )(q, k, v, mask8, g, lse_e, delta_e)
 
     # dk/dv: kv head and block outer; (group, q block) inner with q fastest.
     # Scratch accumulates across BOTH inner axes, so the GQA group sum happens
@@ -369,16 +399,17 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda b, kv, j, gq, i: (b, kv, j, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, block_k), lambda b, kv, j, gq, i: (b, j),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b, kv, j, gq, i: (b, 0, j),
                          memory_space=_VMEM),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, kv, j, gq, i: (b, kv * G + gq, i),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, kv, j, gq, i: (b, kv * G + gq, i),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
                          memory_space=_VMEM),
         ],
         out_specs=[
@@ -394,7 +425,7 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask_i32, g, lse, delta)
+    )(q, k, v, mask8, g, lse_e, delta_e)
     return dq, dk, dv
 
 
