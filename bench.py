@@ -234,7 +234,10 @@ def _decode_on_chip_check(jax) -> dict:
     # starts: 0, mid-block, block-aligned, just-under-block boundary
     st = jnp.asarray([0, 37, 256, 255][:B], jnp.int32)
     fl = jnp.asarray([T - (17 * i) % 64 for i in range(B)], jnp.int32)
-    o_p = decode_attention(qd, kc, vc, st, fl)
+    # explicit block 128 → 4 kv blocks at T=512: the scalar-prefetch clamp
+    # and block-revisit logic must actually step across blocks (the tuned
+    # 512 default would collapse the grid to one trivial block)
+    o_p = decode_attention(qd, kc, vc, st, fl, block_k=128)
     o_r = reference_decode_attention(qd, kc, vc, st, fl)
     derr = _rel_err(jnp, o_p, o_r)
     return {
@@ -268,17 +271,16 @@ def _flash_on_chip_check(jax) -> dict:
 
         return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
 
-    def rel_err(a, b):
-        a = a.astype(jnp.float32)
-        b = b.astype(jnp.float32)
-        return float(jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(b)) + 1e-6))
-
-    out_p = flash_attention(q, k, v, key_valid, causal=True)
+    # explicit block 128 so the T=512 grid has 4x4 kv/q blocks: the check
+    # must exercise cross-block online-softmax carry and the causal skip,
+    # not collapse to one block under the (tuned) 512 default
+    blk = dict(block_q=128, block_k=128)
+    out_p = flash_attention(q, k, v, key_valid, causal=True, **blk)
     out_r = reference_attention(q, k, v, key_valid, causal=True)
-    fwd_err = rel_err(out_p, out_r)
-    gp = loss(lambda q, k, v: flash_attention(q, k, v, key_valid, True))(q, k, v)
+    fwd_err = _rel_err(jnp, out_p, out_r)
+    gp = loss(lambda q, k, v: flash_attention(q, k, v, key_valid, True, **blk))(q, k, v)
     gr = loss(lambda q, k, v: reference_attention(q, k, v, key_valid, True))(q, k, v)
-    bwd_err = max(rel_err(a, b) for a, b in zip(gp, gr))
+    bwd_err = max(_rel_err(jnp, a, b) for a, b in zip(gp, gr))
     tol = 0.02  # relative; bf16 inputs, f32 accumulation
     status = "ok" if (fwd_err < tol and bwd_err < tol) else "MISMATCH"
     return {
@@ -328,7 +330,7 @@ def run_bench(jax, init_error):
         "BENCH_MODEL", "tiny" if on_cpu_fallback else "1_5b"
     )
     n_updates = int(os.environ.get("BENCH_UPDATES", 2))
-    attention_impl = os.environ.get("BENCH_ATTENTION", "xla")
+    attention_impl = os.environ.get("BENCH_ATTENTION", "auto")
     use_lora = os.environ.get("BENCH_LORA", "1") == "1"
     if on_cpu_fallback:
         # reduced shapes so the fallback terminates; payload marks backend=cpu
@@ -420,7 +422,9 @@ def run_bench(jax, init_error):
     seq_len = ctx + response_len
     decode_tokens = rollout_rows * response_len
     prefill_tokens = rollout_rows * ctx
-    score_tokens = 2 * rollout_rows * seq_len          # policy + ref pass
+    # GRPO keeps 1-of-N BEFORE the logprob pass, so only `episodes` rows are
+    # scored (policy + ref) — counting all B·n rows would inflate MFU
+    score_tokens = 2 * episodes_per_update * seq_len
     train_tokens = cfg.num_ppo_epochs * episodes_per_update * seq_len
     fwd = 2.0 * n_params                                # FLOPs per token fwd
     flops_per_update = (

@@ -25,9 +25,17 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     max_position_embeddings: int = 32768
-    # "xla": einsum attention fused by XLA; "pallas": blockwise flash kernel
-    # (ops/attention.py) on full self-attention paths, XLA on decode steps
-    attention_impl: str = "xla"
+    # "xla": einsum attention fused by XLA everywhere.
+    # "pallas": blockwise flash kernel (ops/attention.py) on self-attention
+    #   paths + prefix-bounded decode kernel (ops/decode_attention.py).
+    # "auto" (default): picks per call site from real-TPU v5e sweeps — flash
+    #   at padded T >= _FLASH_AUTO_MIN_T (pallas-512 beats XLA 1.4x at T=512
+    #   and 21x at T=8192; ties below), decode kernel at cache
+    #   T_max >= _DECODE_AUTO_MIN_T (XLA's single fused matmul wins on short
+    #   caches; prefix-skip bandwidth wins on long ones). Off-TPU backends
+    #   always resolve to XLA (interpret-mode Pallas is a test vehicle, not
+    #   an execution path).
+    attention_impl: str = "auto"
 
     @property
     def actual_head_dim(self) -> int:

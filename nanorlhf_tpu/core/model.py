@@ -106,6 +106,32 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     return (xf * cos + rf * sin).astype(x.dtype)
 
 
+# "auto" thresholds, from real TPU v5e sweeps (fwd+bwd, Qwen2-1.5B head
+# geometry): pallas-512 flash ties XLA at T=256 and wins from T=512 up
+# (11.9→7.4ms at T=512; 371→17ms at T=8192). The decode kernel's
+# prefix-bounded reads only pay off once the cache is large enough that
+# skipped HBM traffic beats its finer-grained grid (XLA decode is one fused
+# masked matmul and wins on short caches).
+_FLASH_AUTO_MIN_T = 512
+_DECODE_AUTO_MIN_T = 2048
+
+
+def use_flash(impl: str, seq_len: int) -> bool:
+    """Resolve the train/prefill self-attention impl for a padded length."""
+    if impl == "pallas":
+        return True
+    return (impl == "auto" and seq_len >= _FLASH_AUTO_MIN_T
+            and jax.default_backend() == "tpu")
+
+
+def use_decode_kernel(impl: str, cache_len: int) -> bool:
+    """Resolve the single-token decode-attention impl for a cache size."""
+    if impl == "pallas":
+        return True
+    return (impl == "auto" and cache_len >= _DECODE_AUTO_MIN_T
+            and jax.default_backend() == "tpu")
+
+
 def gqa_attention(
     q: jnp.ndarray,       # [B, H, Tq, hd]
     k: jnp.ndarray,       # [B, KV, Tk, hd]
@@ -121,7 +147,7 @@ def gqa_attention(
     the general XLA path."""
     B, H, Tq, hd = q.shape
     Tk = k.shape[2]
-    if impl == "pallas" and mask_is_causal_x_keyvalid and Tq == Tk and Tq > 1:
+    if use_flash(impl, Tq) and mask_is_causal_x_keyvalid and Tq == Tk and Tq > 1:
         # key-validity = the mask's last query row (causal there is all-True)
         from nanorlhf_tpu.ops.attention import flash_attention
 
@@ -188,13 +214,14 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cache_index, 0))
         new_cache = (k_cache, v_cache)
-        if T > 1 and config.attention_impl == "pallas":
+        if T > 1 and use_flash(config.attention_impl, T):
             # prefill: cache slots beyond T are masked anyway, so attend over
             # the local-length K/V through the flash kernel instead of the
             # T_max-padded cache
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
                                 mask_is_causal_x_keyvalid=True)
-        elif T == 1 and config.attention_impl == "pallas" and decode_bounds is not None:
+        elif (T == 1 and decode_bounds is not None
+              and use_decode_kernel(config.attention_impl, k_cache.shape[2])):
             # decode: prefix-bounded Pallas kernel reads only the filled
             # cache range instead of the masked T_max square
             from nanorlhf_tpu.ops.decode_attention import decode_attention

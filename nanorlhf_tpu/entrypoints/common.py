@@ -23,7 +23,7 @@ from nanorlhf_tpu.rewards.builders import make_torch_rm_reward
 from nanorlhf_tpu.trainer import RLConfig, RLTrainer
 
 
-def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "xla"):
+def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "auto"):
     """(ModelConfig, params, tokenizer): HF checkpoint dir → load it; else an
     offline demo model (1.5B-shaped unless path says 'tiny')."""
     import dataclasses

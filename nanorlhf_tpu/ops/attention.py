@@ -227,7 +227,7 @@ def _flash_forward(q, k, v, key_valid, causal: bool, block_q: int, block_k: int,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, out_ref,
                dq_out_ref, dq_acc_ref,
                *, scale: float, block_q: int, block_k: int, causal: bool):
     kv_idx = pl.program_id(3)
@@ -247,7 +247,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]                       # [Bq, 1]
-        delta = delta_ref[0, 0][:, :1]                   # [Bq, 1]
+        # D_i = Σ_d dO·O, recomputed per block ([Bq, d] elementwise+reduce) —
+        # cheaper than streaming a lane-expanded [B, H, T, 128] HBM array
+        delta = jnp.sum(
+            do * out_ref[0, 0].astype(jnp.float32), axis=-1, keepdims=True
+        )                                                # [Bq, 1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -275,7 +279,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         dq_out_ref[0, 0] = dq_acc_ref[:].astype(dq_out_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, out_ref,
                 dk_out_ref, dv_out_ref, dk_acc_ref, dv_acc_ref,
                 *, scale: float, block_q: int, block_k: int, causal: bool):
     # grid (B, KV, n_kv, G, n_q): q blocks fastest, then the GQA group — the
@@ -300,7 +304,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        delta = jnp.sum(                                 # see _dq_kernel
+            do * out_ref[0, 0].astype(jnp.float32), axis=-1, keepdims=True
+        )
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -342,15 +348,12 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
     scale = 1.0 / (d ** 0.5)
     n_q = pl.cdiv(T, block_q)
     n_kv = pl.cdiv(T, block_k)
-    # sublane-broadcast mask / lane-expanded lse+delta: see _flash_forward
-    # (lse arrives already lane-expanded from the forward)
+    # sublane-broadcast mask / lane-expanded lse: see _flash_forward (lse
+    # arrives already lane-expanded; delta is recomputed per block in-kernel
+    # from `out`, so no lane-expanded delta array exists)
     mask8 = jnp.broadcast_to(
         key_valid.astype(jnp.int32)[:, None, :], (B, _SUBLANES, T)
     )
-    # D_i = Σ_j dO·O — cheap elementwise+reduce, left to XLA fusion
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    lse_e = lse
-    delta_e = jnp.broadcast_to(delta[..., None], (B, H, T, _LANES))
 
     common_q_specs = dict(
         q=pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0),
@@ -374,12 +377,12 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
         grid=(B, H, n_q, n_kv),
         in_specs=[common_q_specs["q"], common_q_specs["k"], common_q_specs["v"],
                   common_q_specs["mask"], common_q_specs["do"],
-                  common_q_specs["lse"], common_q_specs["lse"]],
+                  common_q_specs["lse"], common_q_specs["do"]],
         out_specs=common_q_specs["q"],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask8, g, lse_e, delta_e)
+    )(q, k, v, mask8, g, lse, out)
 
     # dk/dv: kv head and block outer; (group, q block) inner with q fastest.
     # Scratch accumulates across BOTH inner axes, so the GQA group sum happens
@@ -408,7 +411,7 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
             pl.BlockSpec((1, 1, block_q, _LANES),
                          lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
                          memory_space=_VMEM),
-            pl.BlockSpec((1, 1, block_q, _LANES),
+            pl.BlockSpec((1, 1, block_q, d),
                          lambda b, kv, j, gq, i: (b, kv * G + gq, i, 0),
                          memory_space=_VMEM),
         ],
@@ -425,7 +428,7 @@ def _flash_backward(q, k, v, key_valid, out, lse, g, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, mask8, g, lse_e, delta_e)
+    )(q, k, v, mask8, g, lse, out)
     return dq, dk, dv
 
 
@@ -478,8 +481,8 @@ def flash_attention(
     v: jnp.ndarray,          # [B, KV, T, d]
     key_valid: jnp.ndarray,  # [B, T] bool
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Blockwise flash attention; pads T up to a block multiple internally.
 

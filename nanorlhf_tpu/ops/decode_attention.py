@@ -117,7 +117,7 @@ def decode_attention(
     v_cache: jnp.ndarray,  # [B, KV, T_max, hd]
     start: jnp.ndarray,    # [B] int32: first valid cache slot (left-pad offset)
     filled: jnp.ndarray,   # [B] int32: one past the last valid slot
-    block_k: int = 256,
+    block_k: int = 512,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Prefix-bounded decode attention. Returns [B, H, hd]."""

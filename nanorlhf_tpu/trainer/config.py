@@ -109,7 +109,7 @@ class RLConfig:
 
     # ---- memory / kernels ----
     gradient_checkpointing: bool = True
-    attention_impl: str = "xla"   # "pallas" = flash kernel on full-seq paths
+    attention_impl: str = "auto"  # xla | pallas | auto (by seq length, on TPU)
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1
