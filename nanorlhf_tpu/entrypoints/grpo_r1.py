@@ -30,11 +30,14 @@ TEMPLATE = (
 )
 
 
-def build_config(sequence_parallel: int = 1) -> RLConfig:
+def build_config(sequence_parallel: int = 1,
+                 rollout_ahead: bool = False) -> RLConfig:
     """`sequence_parallel > 1` shards the 8k-token scoring/update passes over
     an sp mesh axis (ring attention, `parallel/sp.py`) — context beyond one
     chip's HBM. Devices split as (data = n/sp, sp); response_length must be
-    a multiple of sp."""
+    a multiple of sp. `rollout_ahead` overlaps the next update's generation
+    with this update's sympy grading (one-update-stale rollouts, clip-
+    corrected — trainer/config.py)."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-r1-v0",
@@ -56,6 +59,7 @@ def build_config(sequence_parallel: int = 1) -> RLConfig:
         save_steps=1,
         save_total_limit=8,
     )
+    cfg.rollout_ahead = rollout_ahead
     if sequence_parallel > 1:
         from nanorlhf_tpu.parallel import MeshConfig
 

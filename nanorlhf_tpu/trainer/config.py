@@ -71,6 +71,16 @@ class RLConfig:
     # still runs). Decode-vs-scoring numerics make epoch-1 ratios deviate
     # from exactly 1; the drift is logged as sampler_capture/ratio_drift_new.
     sampler_logprob_capture: bool = False
+    # opt-in PipelineRL-style overlap: the rollout for update k+1 is
+    # DISPATCHED (async) before the host-side decode/reward/assembly of
+    # update k, so reward grading (sympy subprocesses, RM scoring) overlaps
+    # device generation instead of serializing with it. Each rollout then
+    # samples from the params of update k-1 (one update stale); the scoring
+    # pass still measures the current policy, so the PPO-clip ratio absorbs
+    # the off-policy drift exactly as the reference's off-policy-capable
+    # losses do (`REINFORCE/reinforce_trainer.py:637`). Rollout PRNG comes
+    # from a dedicated stream, so update 1 is bit-identical either way.
+    rollout_ahead: bool = False
 
     # ---- optimization ----
     learning_rate: float = 6e-6

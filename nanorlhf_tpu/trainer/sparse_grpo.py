@@ -54,7 +54,11 @@ from nanorlhf_tpu.trainer.bucketing import (
     round_up_to_menu,
     shape_menu,
 )
-from nanorlhf_tpu.trainer.trainer import RLTrainer, forward_token_budget
+from nanorlhf_tpu.trainer.trainer import (
+    RLTrainer,
+    RolloutStream,
+    forward_token_budget,
+)
 
 # forward budget comes from forward_token_budget (activation ∧ vocab caps);
 # backward keeps the reference's dedicated constant (`grpo_r1_trainer.py:700`)
@@ -292,27 +296,37 @@ class SparseGRPOTrainer(RLTrainer):
             if num_updates is None else num_updates
         )
 
-        for update in range(1, n_updates + 1):
-            t_start = time.time()
-            self.state["episode"] += cfg.batch_size
-            queries = np.asarray(next(self._iter))
-            batch_size = queries.shape[0]
-
-            # ---- rollout + reward -----------------------------------------
-            self.key, gk = jax.random.split(self.key)
+        def rollout_body(queries, gk):
+            """DISPATCH one rollout (async — nothing blocks until fetched)."""
             q_j = jnp.asarray(queries)
             gen_out = generate(
                 self.params, self.mcfg, q_j, q_j != pad_id, gk, sampling,
                 eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
             )
+            return {"queries": queries, "gen_out": gen_out}
+
+        stream = RolloutStream(self, rollout_body)
+        for update in range(1, n_updates + 1):
+            t_start = time.time()
+            self.state["episode"] += cfg.batch_size
+
+            # ---- rollout + reward -----------------------------------------
+            ro = stream.fetch_or_dispatch()
+            queries = ro["queries"]
+            batch_size = queries.shape[0]
             if capture:
-                responses, captured_lp = gen_out
+                responses, captured_lp = ro["gen_out"]
                 responses = np.asarray(responses)
                 captured_lp = np.asarray(captured_lp)
             else:
-                responses = np.asarray(gen_out)
+                responses = np.asarray(ro["gen_out"])
                 captured_lp = None
+            if cfg.rollout_ahead and update < n_updates:
+                # overlap the NEXT generation with this update's grading —
+                # in the r1 path the sympy/subprocess graders are the
+                # dominant host cost, so this is where the overlap pays most
+                stream.prefetch()
             question_strings = [
                 q.replace(tok.pad_token, "") for q in tok.batch_decode(queries)
             ]

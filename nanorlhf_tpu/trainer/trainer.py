@@ -100,6 +100,49 @@ def pad_chunk(rows: np.ndarray, chunk: int) -> np.ndarray:
     return np.concatenate([rows, reps], axis=0)
 
 
+class RolloutStream:
+    """Prefetchable rollout dispatcher over a stateless generation PRNG.
+
+    `dispatch()` pulls the next prompt batch and ASYNC-dispatches generation
+    through `body(queries, gen_key)` (nothing blocks until the caller reads
+    the returned arrays). `fetch_or_dispatch()` consumes the prefetched
+    rollout if one is pending and records its index in
+    `trainer.state["rollouts"]` — the consumed-rollout counter that
+    checkpoint/resume persists to fast-forward the data stream and re-key
+    generation exactly (Sparse-GRPO skip-updates consume a rollout without
+    advancing global_step, so global_step alone under-counts).
+
+    Generation keys are `fold_in(base, index)` rather than splits of the
+    evolving trainer key: rollout_ahead dispatches rollout k+1 before update
+    k's host-side draws, and a shared stream would reorder splits between
+    modes (and break bit-exact resume).
+    """
+
+    def __init__(self, trainer, body: Callable):
+        self._t = trainer
+        self._body = body
+        self._idx = trainer.state["rollouts"]
+        self._pending = None
+
+    def dispatch(self) -> dict:
+        t = self._t
+        queries = np.asarray(next(t._iter))
+        key = jax.random.fold_in(t._rollout_base, self._idx)
+        ro = self._body(queries, key)
+        ro["_index"] = self._idx
+        self._idx += 1
+        return ro
+
+    def fetch_or_dispatch(self) -> dict:
+        ro = self._pending or self.dispatch()
+        self._pending = None
+        self._t.state["rollouts"] = ro["_index"] + 1
+        return ro
+
+    def prefetch(self) -> None:
+        self._pending = self.dispatch()
+
+
 class RLTrainer:
     """Unified online-RL trainer.
 
@@ -140,6 +183,12 @@ class RLTrainer:
         )
 
         self.key = rng_key if rng_key is not None else jax.random.PRNGKey(config.seed)
+        # generation PRNG is a dedicated STATELESS stream keyed by rollout
+        # index: rollout_ahead dispatches rollout k+1 before update k's
+        # host-side key draws, and a shared evolving stream would reorder
+        # splits between modes (and break bit-exact resume — the index-keyed
+        # form needs only global_step to reconstruct)
+        self._rollout_base = jax.random.fold_in(self.key, 0x5E11)
 
         # ---- LoRA + ref policy -------------------------------------------
         self.lora_cfg = (
@@ -213,7 +262,11 @@ class RLTrainer:
         # opt_steps counts ACTUAL optimizer.update calls — the schedule index
         # for the `lr` metric (a derived formula drifts when the minibatch
         # loop doesn't divide evenly)
-        self.state = {"episode": 0, "global_step": 0, "opt_steps": 0}
+        # "rollouts" counts CONSUMED rollouts (== global_step for the dense
+        # runtime; >= for sparse, whose all-zero-advantage skips consume a
+        # batch without stepping) — the resume cursor for data + PRNG streams
+        self.state = {"episode": 0, "global_step": 0, "opt_steps": 0,
+                      "rollouts": 0}
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -581,49 +634,59 @@ class RLTrainer:
         ctx_menu = shape_menu(self.dataset.input_ids.shape[1], min_value=16) \
             if hasattr(self.dataset, "input_ids") else None
 
-        for update in range(1, n_updates + 1):
-            t_start = time.time()
-            self.state["episode"] += cfg.batch_size
-            queries = np.asarray(next(self._iter))          # [B, Tp] left-padded
+        def rollout_body(queries, gen_key):
+            """DISPATCH one rollout (async — nothing blocks until fetched)."""
             if ctx_menu is not None:
                 # r1's de-padding applied to every algorithm: batches of short
                 # prompts roll out / score at a menu-rounded context (warm jit
                 # cache) instead of the dataset-wide pad width
                 queries = depad_queries(queries, pad_id, ctx_menu)
-            batch_size, context_length = queries.shape
             if self._sp_on():
-                self._sp_check_widths(context_length)
+                self._sp_check_widths(queries.shape[1])
             queries_j = jax.device_put(
                 jnp.asarray(queries), batch_sharding(self.mesh)
             )
             prompt_mask = queries_j != pad_id
-
-            # ---- ROLLOUT -------------------------------------------------
-            self.key, gen_key = jax.random.split(self.key)
-            captured_lp = None
-            with self.timer.phase("rollout"):
-                gen_out = generate(
-                    self.params, self.mcfg, queries_j, prompt_mask, gen_key,
-                    sampling, eos_token_id=eos_id, pad_token_id=pad_id,
-                    lora_scale=self.lora_scale,
-                )                                           # [B*n, T]
-                if capture:
-                    responses, captured_lp = gen_out
-                    captured_lp = np.asarray(captured_lp)
-                else:
-                    responses = gen_out
-                jax.block_until_ready(responses)
-            greedy_responses = None
+            gen_out = generate(
+                self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                sampling, eos_token_id=eos_id, pad_token_id=pad_id,
+                lora_scale=self.lora_scale,
+            )                                               # [B*n, T]
+            greedy = None
             if self.algo == AlgoName.REMAX:
                 # extra greedy rollout as baseline (`ReMax/remax_trainer.py:166-185`)
-                with self.timer.phase("rollout"):
-                    greedy_responses = generate(
-                        self.params, self.mcfg, queries_j, prompt_mask, gen_key,
-                        SamplingParams(greedy=True, max_tokens=cfg.response_length),
-                        eos_token_id=eos_id, pad_token_id=pad_id,
-                        lora_scale=self.lora_scale,
-                    )
+                greedy = generate(
+                    self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                    SamplingParams(greedy=True, max_tokens=cfg.response_length),
+                    eos_token_id=eos_id, pad_token_id=pad_id,
+                    lora_scale=self.lora_scale,
+                )
+            return {"queries": queries, "gen_out": gen_out, "greedy": greedy}
+
+        stream = RolloutStream(self, rollout_body)
+        for update in range(1, n_updates + 1):
+            t_start = time.time()
+            self.state["episode"] += cfg.batch_size
+
+            # ---- ROLLOUT -------------------------------------------------
+            with self.timer.phase("rollout"):
+                ro = stream.fetch_or_dispatch()
+                if capture:
+                    responses, captured_lp = ro["gen_out"]
+                    captured_lp = np.asarray(captured_lp)
+                else:
+                    responses, captured_lp = ro["gen_out"], None
+                jax.block_until_ready(responses)
+                greedy_responses = ro["greedy"]
+                if greedy_responses is not None:
                     greedy_responses.block_until_ready()
+            queries = ro["queries"]
+            batch_size, context_length = queries.shape
+            if cfg.rollout_ahead and update < n_updates:
+                # dispatch rollout k+1 NOW (from the pre-update-k params, one
+                # update stale): the device generates while the host below
+                # decodes/grades update k's batch
+                stream.prefetch()
 
             # ---- REWARD (host-side, user callable) -------------------------
             question_strings = [
@@ -858,7 +921,8 @@ class RLTrainer:
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
                     extra_state={"episode": self.state["episode"],
-                                 "opt_steps": self.state["opt_steps"]},
+                                 "opt_steps": self.state["opt_steps"],
+                                 "rollouts": self.state["rollouts"]},
                     value_params=self.value_params if cfg.save_value_model else None,
                 )
 
@@ -909,6 +973,19 @@ class RLTrainer:
         if "rng_key" in tstate:
             raw = jnp.asarray(np.asarray(tstate["rng_key"], dtype=np.uint32))
             self.key = jax.random.wrap_key_data(raw) if tstate.get("rng_key_typed") else raw
+        # data-stream position: the loader is a deterministic function of
+        # (seed, batch_size), so skipping the persisted consumed-rollout
+        # count reproduces the stream the uninterrupted run would see (a
+        # rollout_ahead prefetch in flight at save time was abandoned — its
+        # batch is re-drawn; sparse-GRPO skip-updates consumed batches
+        # without stepping, hence the dedicated counter). Without this a
+        # resumed run silently re-trains on the first batches. Pre-counter
+        # checkpoints fall back to global_step (exact for the dense runtime).
+        self.state["rollouts"] = tstate.get("rollouts", tstate["step"])
+        self._iter = self.dataset.loader(self.cfg.batch_size, self.cfg.seed) \
+            if hasattr(self.dataset, "loader") else iter(self.dataset)
+        for _ in range(self.state["rollouts"]):
+            next(self._iter)
         return self.state
 
     def close(self):
