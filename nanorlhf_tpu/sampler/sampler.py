@@ -56,6 +56,20 @@ class SamplingParams:
     # equal `logprobs_from_logits(logits, tokens, temperature)` up to
     # decode-vs-scoring numerics; the trainer logs the residual ratio drift.
     capture_logprobs: bool = False
+    # use jax.lax.approx_max_k for the top-k pre-trim: XLA lowers exact
+    # lax.top_k to a FULL VOCAB SORT on TPU, which at LLM vocabularies can
+    # dominate the decode step; ApproxTopK is the hardware-native O(V) path
+    # (exact on CPU). The candidate SET becomes approximate (recall 0.99 per
+    # candidate, NOT rank-restricted): a missed in-nucleus token cannot be
+    # sampled that step, and the exclusive-cumsum keep rule then undercounts,
+    # letting the boundary widen slightly past top_p. The sampling
+    # distribution therefore deviates from the exact truncated nucleus —
+    # acceptable for RL rollouts, where the ratio math scores the SAMPLED
+    # token's full-distribution logprob (exact either way; the
+    # truncated-vs-full mismatch is inherent to nucleus sampling and present
+    # in the reference's vLLM path too). Set False for the exact candidate
+    # set (full-sort cost on TPU).
+    approx_top_k: bool = True
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -76,7 +90,8 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     return jnp.where(logits >= threshold, logits, -jnp.inf)
 
 
-def _sample_token(key, logits, temperature, top_p, greedy, top_k=64):
+def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
+                  approx_top_k=True):
     """Sample one token per row.
 
     `top_p >= 1.0` (no nucleus requested) stays an EXACT full-vocab
@@ -97,7 +112,15 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64):
             logits = top_p_filter(logits, top_p)   # exact full-vocab nucleus
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
     k = min(top_k, logits.shape[-1])
-    top_logits, top_idx = jax.lax.top_k(logits, k)      # descending
+    if approx_top_k and k < logits.shape[-1]:
+        # hardware-native approximate top-k (exact lax.top_k is a full-vocab
+        # sort on TPU); aggregate_to_topk (default) already returns the
+        # candidates exactly sorted descending
+        top_logits, top_idx = jax.lax.approx_max_k(
+            logits, k, recall_target=0.99
+        )
+    else:
+        top_logits, top_idx = jax.lax.top_k(logits, k)  # descending
     lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
     probs = jnp.exp(top_logits - lse)                   # true (unrenormalized) probs
     cum = jnp.cumsum(probs, axis=-1)
@@ -121,7 +144,7 @@ def _token_logprob(logits, tok, temperature):
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
                      "temperature", "top_p", "greedy", "lora_scale", "top_k",
-                     "capture_logprobs"),
+                     "capture_logprobs", "approx_top_k"),
 )
 def generate_tokens(
     params: dict,
@@ -139,6 +162,7 @@ def generate_tokens(
     lora_scale: float = 1.0,
     top_k: int = 64,
     capture_logprobs: bool = False,
+    approx_top_k: bool = True,
 ) -> jnp.ndarray:
     """Core jitted loop: one sample per row. Returns [B, max_tokens] int32,
     or (tokens, logprobs [B, max_tokens] f32) with capture_logprobs."""
@@ -157,7 +181,8 @@ def generate_tokens(
     out0 = jnp.full((B, max_tokens), pad_token_id, jnp.int32)
     lp0 = jnp.zeros((B, max_tokens), jnp.float32)
     key, k0 = jax.random.split(key)
-    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy, top_k)
+    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy,
+                         top_k, approx_top_k)
     out0 = out0.at[:, 0].set(tok0)
     if capture_logprobs:
         lp0 = lp0.at[:, 0].set(_token_logprob(first_logits, tok0, temperature))
@@ -179,7 +204,8 @@ def generate_tokens(
             lora_scale=lora_scale,
         )
         key, k = jax.random.split(key)
-        tok = _sample_token(k, logits, temperature, top_p, greedy, top_k)
+        tok = _sample_token(k, logits, temperature, top_p, greedy,
+                            top_k, approx_top_k)
         tok = jnp.where(done, pad_token_id, tok)
         write = (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None]
         out = jnp.where(write, tok[:, None], out)
@@ -225,4 +251,5 @@ def generate(
         lora_scale=lora_scale,
         top_k=sampling.top_k,
         capture_logprobs=sampling.capture_logprobs,
+        approx_top_k=sampling.approx_top_k,
     )
