@@ -170,10 +170,19 @@ def gqa_attention(
 
 def _proj(h, layer_params, lora_layer, name, lora_scale):
     """x @ W (+ bias) (+ LoRA (x@A)@B · scale) — LoRA applied in-graph so
-    sampling/scoring/training all see fresh adapter weights (core/lora.py)."""
-    y = h @ layer_params[name]["kernel"]
-    if "bias" in layer_params[name]:
-        y = y + layer_params[name]["bias"]
+    sampling/scoring/training all see fresh adapter weights (core/lora.py).
+
+    Weight-only int8 form (`kernel_q` + per-output-channel `kernel_scale`,
+    core/quant.py): the upcast feeds the matmul directly (int8 stays the HBM
+    resident form) and the scale folds into the epilogue."""
+    p = layer_params[name]
+    if "kernel_q" in p:
+        y = h @ p["kernel_q"].astype(h.dtype)
+        y = (y.astype(jnp.float32) * p["kernel_scale"]).astype(h.dtype)
+    else:
+        y = h @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
     if lora_layer is not None and name in lora_layer:
         ab = lora_layer[name]
         y = y + ((h @ ab["a"]) @ ab["b"]) * lora_scale

@@ -87,6 +87,15 @@ _RULES_BY_LEN = sorted(_RULES.items(), key=lambda kv: -len(kv[0]))
 
 
 def _spec_for_path(path: tuple[str, ...]) -> P:
+    # int8 rollout kernels (core/quant.py): kernel_q shards exactly like the
+    # kernel it replaces; its per-output-channel scale [L, 1, out] keeps the
+    # kernel's out-axis sharding with the contracted axis unsharded
+    if path and path[-1] == "kernel_q":
+        path = path[:-1] + ("kernel",)
+    elif path and path[-1] == "kernel_scale":
+        kspec = _spec_for_path(path[:-1] + ("kernel",))
+        return P(*(list(kspec)[:-2] + [None, list(kspec)[-1]])) \
+            if len(kspec) >= 2 else kspec
     for suffix, spec in _RULES_BY_LEN:
         if path[-len(suffix):] == suffix:
             return spec

@@ -120,6 +120,14 @@ class RLConfig:
     # ---- memory / kernels ----
     gradient_checkpointing: bool = True
     attention_impl: str = "auto"  # xla | pallas | auto (by seq length, on TPU)
+    # "int8": generation reads weight-only-quantized base projections (per-
+    # output-channel scales, core/quant.py) — halves decode's HBM weight
+    # traffic. LoRA/embeddings stay exact bf16 in the sampler; scoring and
+    # updates always run exact weights, so the clip ratio corrects the
+    # quantized sampling distribution (same tolerance as rollout_ahead).
+    # Quantized once under LoRA (base frozen); re-quantized per update when
+    # full fine-tuning.
+    rollout_quant: str = "none"   # none | int8
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1

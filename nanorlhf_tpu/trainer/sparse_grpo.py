@@ -300,8 +300,8 @@ class SparseGRPOTrainer(RLTrainer):
             """DISPATCH one rollout (async — nothing blocks until fetched)."""
             q_j = jnp.asarray(queries)
             gen_out = generate(
-                self.params, self.mcfg, q_j, q_j != pad_id, gk, sampling,
-                eos_token_id=eos_id, pad_token_id=pad_id,
+                self._rollout_params(), self.mcfg, q_j, q_j != pad_id, gk,
+                sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
             )
             return {"queries": queries, "gen_out": gen_out}

@@ -259,6 +259,13 @@ class RLTrainer:
 
         self.timer = PhaseTimer()
         self._update_fn = self._make_update_fn()
+        # int8 rollout weights (core/quant.py): quantize the frozen base
+        # projections once under LoRA; full-FT re-quantizes at each dispatch
+        self._quant_layers = None
+        if config.rollout_quant == "int8":
+            self._refresh_quant_layers()
+        elif config.rollout_quant != "none":
+            raise ValueError(f"rollout_quant={config.rollout_quant!r}")
         # opt_steps counts ACTUAL optimizer.update calls — the schedule index
         # for the `lr` metric (a derived formula drifts when the minibatch
         # loop doesn't divide evenly)
@@ -267,6 +274,28 @@ class RLTrainer:
         # batch without stepping) — the resume cursor for data + PRNG streams
         self.state = {"episode": 0, "global_step": 0, "opt_steps": 0,
                       "rollouts": 0}
+
+    # ------------------------------------------------------------------ #
+    # rollout weight quantization
+    # ------------------------------------------------------------------ #
+
+    def _refresh_quant_layers(self):
+        from nanorlhf_tpu.core.quant import quantize_layers
+
+        q = quantize_layers(self.params["layers"])
+        self._quant_layers = shard_params({"layers": q}, self.mesh)["layers"]
+
+    def _rollout_params(self):
+        """The param tree generation samples from: exact everywhere, except
+        int8 base projections when rollout_quant is on (LoRA/embed/norm are
+        always the live exact arrays — see core/quant.py)."""
+        if self._quant_layers is None:
+            return self.params
+        if not self.cfg.use_lora:  # full FT: base changed since last update
+            self._refresh_quant_layers()
+        from nanorlhf_tpu.core.quant import rollout_view
+
+        return rollout_view(self.params, self._quant_layers)
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -647,8 +676,9 @@ class RLTrainer:
                 jnp.asarray(queries), batch_sharding(self.mesh)
             )
             prompt_mask = queries_j != pad_id
+            gen_params = self._rollout_params()
             gen_out = generate(
-                self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                gen_params, self.mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
             )                                               # [B*n, T]
@@ -656,7 +686,7 @@ class RLTrainer:
             if self.algo == AlgoName.REMAX:
                 # extra greedy rollout as baseline (`ReMax/remax_trainer.py:166-185`)
                 greedy = generate(
-                    self.params, self.mcfg, queries_j, prompt_mask, gen_key,
+                    gen_params, self.mcfg, queries_j, prompt_mask, gen_key,
                     SamplingParams(greedy=True, max_tokens=cfg.response_length),
                     eos_token_id=eos_id, pad_token_id=pad_id,
                     lora_scale=self.lora_scale,
@@ -932,6 +962,8 @@ class RLTrainer:
             best = self.ckpt.best_step()
             if best is not None and best != self.state["global_step"]:
                 self.params = self.ckpt.restore(best, self._restore_template())["params"]
+                if self._quant_layers is not None:
+                    self._refresh_quant_layers()
                 print(f"loaded best checkpoint (step {best})")
         return self.state
 
@@ -962,6 +994,8 @@ class RLTrainer:
             # resuming an earlier step abandons the newer trajectory
             self.ckpt.truncate_after(step)
         self.params = restored["params"]
+        if self._quant_layers is not None:
+            self._refresh_quant_layers()  # re-quantize the RESTORED base
         if "opt_state" in restored:
             self.opt_state = restored["opt_state"]
         if "value" in restored:

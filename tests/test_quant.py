@@ -1,0 +1,66 @@
+"""Weight-only int8 rollout quantization (core/quant.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.core.model import padded_forward_logits
+from nanorlhf_tpu.core.quant import (
+    dequantize_kernel,
+    quantize_kernel,
+    quantize_layers,
+    rollout_view,
+)
+from nanorlhf_tpu.trainer import AlgoName
+
+from test_trainer_smoke import make_trainer
+
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 96), jnp.float32)
+    q, scale = quantize_kernel(w)
+    assert q.dtype == jnp.int8 and scale.shape == (4, 1, 96)
+    back = dequantize_kernel(q, scale, jnp.float32)
+    # symmetric per-channel int8: error <= scale/2 = absmax/254 per element
+    absmax = np.abs(np.asarray(w)).max(axis=1, keepdims=True)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= absmax / 254.0 + 1e-7).all()
+
+
+def test_quantized_forward_close_to_exact():
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    qparams = rollout_view(params, quantize_layers(params["layers"]))
+    ids = jnp.asarray(np.full((2, 12), 7, np.int32))
+    exact = padded_forward_logits(params, mcfg, ids, 0)
+    quant = padded_forward_logits(qparams, mcfg, ids, 0)
+    # logits agree to int8-noise level; argmax (greedy decode) agrees
+    rel = float(jnp.max(jnp.abs(exact - quant)) / (jnp.max(jnp.abs(exact)) + 1e-6))
+    assert rel < 0.05, rel
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(exact, -1)), np.asarray(jnp.argmax(quant, -1))
+    )
+
+
+@pytest.mark.parametrize("use_lora", [True, False])
+def test_trainer_int8_rollout_smoke(tmp_path, use_lora):
+    trainer = make_trainer(
+        AlgoName.GRPO, tmp_path, total_episodes=32, save_steps=0,
+        rollout_quant="int8", use_lora=use_lora,
+    )
+    assert trainer._quant_layers is not None
+    assert trainer._quant_layers["q_proj"]["kernel_q"].dtype == jnp.int8
+    state = trainer.train()
+    assert state["global_step"] == 2
+
+
+def test_int8_with_rollout_ahead(tmp_path):
+    trainer = make_trainer(
+        AlgoName.GRPO, tmp_path, total_episodes=32, save_steps=0,
+        rollout_quant="int8", rollout_ahead=True,
+    )
+    state = trainer.train()
+    assert state["global_step"] == 2
