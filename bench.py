@@ -21,7 +21,8 @@ allows a single claim holder and can wedge if probed concurrently.
 
 Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
 BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
-BENCH_ATTENTION (xla | pallas), BENCH_LORA (1 | 0),
+BENCH_ATTENTION (xla | pallas | auto), BENCH_LORA (1 | 0),
+BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (1500 s per attempt),
 BENCH_ALLOW_CPU_FALLBACK (1: after all TPU attempts fail, run a reduced
 bench on CPU and mark backend=cpu in the payload rather than emitting
@@ -332,6 +333,8 @@ def run_bench(jax, init_error):
     n_updates = int(os.environ.get("BENCH_UPDATES", 2))
     attention_impl = os.environ.get("BENCH_ATTENTION", "auto")
     use_lora = os.environ.get("BENCH_LORA", "1") == "1"
+    rollout_quant = "int8" if os.environ.get("BENCH_QUANT", "0") == "1" else "none"
+    rollout_ahead = os.environ.get("BENCH_AHEAD", "0") == "1"
     if on_cpu_fallback:
         # reduced shapes so the fallback terminates; payload marks backend=cpu
         n_prompts = min(n_prompts, 8)
@@ -376,6 +379,8 @@ def run_bench(jax, init_error):
         num_ppo_epochs=1,
         kl_coef=0.01,
         use_lora=use_lora,
+        rollout_quant=rollout_quant,
+        rollout_ahead=rollout_ahead,
         gradient_checkpointing=True,
         mesh=MeshConfig(n_dev, 1, 1),
         save_steps=0,
@@ -454,6 +459,8 @@ def run_bench(jax, init_error):
         "n_params": n_params,
         "attention": attention_impl,
         "lora": use_lora,
+        "rollout_quant": rollout_quant,
+        "rollout_ahead": rollout_ahead,
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

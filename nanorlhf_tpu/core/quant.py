@@ -10,11 +10,15 @@ Placement in the RL loop (`RLConfig.rollout_quant="int8"`):
 - generation samples from the quantized base + EXACT bf16 LoRA/embed/norm
   (adapters ride on top in-graph, so policy updates reach the sampler
   immediately — same freshness story as the bf16 path);
-- the scoring pass and the update always run the exact bf16 weights, so
-  the PPO-clip importance ratio measures (and corrects) the quantized
-  sampling distribution exactly the way it absorbs the one-update staleness
-  of `rollout_ahead` — the reference leans on the same off-policy tolerance
-  (`REINFORCE/reinforce_trainer.py:637`).
+- the scoring pass and the update always run the exact bf16 weights. With
+  the default recomputed-old-logprobs scoring, the quantization mismatch
+  enters the gradient as a small unmeasured off-policy bias that the
+  PPO-clip TOLERATES (the same way it tolerates `rollout_ahead`'s
+  one-update staleness — the reference leans on the same tolerance,
+  `REINFORCE/reinforce_trainer.py:637`). To have the ratio MEASURE and
+  importance-correct the quantized behavior distribution, enable
+  `sampler_logprob_capture=True`: the captured logprobs then come from the
+  quantized policy that actually sampled, which is the correct π_behavior.
 
 Under LoRA the base projections are FROZEN, so quantization happens once at
 trainer construction; under full fine-tuning the trainer re-quantizes after

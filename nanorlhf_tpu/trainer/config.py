@@ -123,10 +123,12 @@ class RLConfig:
     # "int8": generation reads weight-only-quantized base projections (per-
     # output-channel scales, core/quant.py) — halves decode's HBM weight
     # traffic. LoRA/embeddings stay exact bf16 in the sampler; scoring and
-    # updates always run exact weights, so the clip ratio corrects the
-    # quantized sampling distribution (same tolerance as rollout_ahead).
-    # Quantized once under LoRA (base frozen); re-quantized per update when
-    # full fine-tuning.
+    # updates always run exact weights. The quantization mismatch is a small
+    # off-policy bias the clip TOLERATES by default; pair with
+    # sampler_logprob_capture=True to importance-correct it exactly
+    # (captured logprobs are the quantized behavior policy's — see
+    # core/quant.py). Quantized once under LoRA (base frozen); re-quantized
+    # per update when full fine-tuning.
     rollout_quant: str = "none"   # none | int8
 
     # ---- checkpoint / eval / logging ----
