@@ -23,6 +23,7 @@ Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
 BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
 BENCH_ATTENTION (xla | pallas | auto), BENCH_LORA (1 | 0),
 BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
+BENCH_KV_QUANT (0 | 1: int8 KV cache),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (1500 s per attempt),
 BENCH_ALLOW_CPU_FALLBACK (1: after all TPU attempts fail, run a reduced
 bench on CPU and mark backend=cpu in the payload rather than emitting
@@ -241,10 +242,24 @@ def _decode_on_chip_check(jax) -> dict:
     o_p = decode_attention(qd, kc, vc, st, fl, block_k=128)
     o_r = reference_decode_attention(qd, kc, vc, st, fl)
     derr = _rel_err(jnp, o_p, o_r)
-    return {
+    result = {
         "decode_check": "ok" if derr < 0.02 else "MISMATCH",
         "decode_max_err": round(derr, 5),
     }
+    # int8-cache variant vs its dequantize-then-exact oracle (same quantized
+    # inputs, so the tolerance is kernel numerics, not quantization error)
+    from nanorlhf_tpu.core.model import _quantize_kv
+    from nanorlhf_tpu.ops.decode_attention import (
+        decode_attention_q8, reference_decode_attention_q8)
+
+    kq, ksc = _quantize_kv(kc.astype(jnp.float32))
+    vq, vsc = _quantize_kv(vc.astype(jnp.float32))
+    o_q = decode_attention_q8(qd, kq, ksc, vq, vsc, st, fl, block_k=128)
+    o_qr = reference_decode_attention_q8(qd, kq, ksc, vq, vsc, st, fl)
+    qerr = _rel_err(jnp, o_q, o_qr)
+    result["decode_q8_check"] = "ok" if qerr < 0.02 else "MISMATCH"
+    result["decode_q8_max_err"] = round(qerr, 5)
+    return result
 
 
 def _flash_on_chip_check(jax) -> dict:
@@ -335,6 +350,7 @@ def run_bench(jax, init_error):
     use_lora = os.environ.get("BENCH_LORA", "1") == "1"
     rollout_quant = "int8" if os.environ.get("BENCH_QUANT", "0") == "1" else "none"
     rollout_ahead = os.environ.get("BENCH_AHEAD", "0") == "1"
+    kv_cache_quant = "int8" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "none"
     if on_cpu_fallback:
         # reduced shapes so the fallback terminates; payload marks backend=cpu
         n_prompts = min(n_prompts, 8)
@@ -381,6 +397,7 @@ def run_bench(jax, init_error):
         use_lora=use_lora,
         rollout_quant=rollout_quant,
         rollout_ahead=rollout_ahead,
+        kv_cache_quant=kv_cache_quant,
         gradient_checkpointing=True,
         mesh=MeshConfig(n_dev, 1, 1),
         save_steps=0,
@@ -461,6 +478,7 @@ def run_bench(jax, init_error):
         "lora": use_lora,
         "rollout_quant": rollout_quant,
         "rollout_ahead": rollout_ahead,
+        "kv_cache_quant": kv_cache_quant,
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

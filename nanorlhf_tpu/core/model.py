@@ -218,6 +218,39 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
     if attn_fn is not None:
         new_cache = None
         out = attn_fn(q, k, v)
+    elif kv_cache is not None and len(kv_cache) == 4:
+        # int8 KV cache: (k_q, k_scales, v_q, v_scales) — see init_kv_cache
+        kq_c, ks_c, vq_c, vs_c = kv_cache
+        k_q, k_s = _quantize_kv(k)
+        v_q, v_s = _quantize_kv(v)
+        kq_c = jax.lax.dynamic_update_slice(kq_c, k_q, (0, 0, cache_index, 0))
+        vq_c = jax.lax.dynamic_update_slice(vq_c, v_q, (0, 0, cache_index, 0))
+        ks_c = jax.lax.dynamic_update_slice(ks_c, k_s, (0, 0, 0, cache_index))
+        vs_c = jax.lax.dynamic_update_slice(vs_c, v_s, (0, 0, 0, cache_index))
+        new_cache = (kq_c, ks_c, vq_c, vs_c)
+        if T > 1 and use_flash(config.attention_impl, T):
+            out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
+                                mask_is_causal_x_keyvalid=True)
+        elif T > 1:
+            out = gqa_attention(q, k, v, mask[..., :T])
+        elif (decode_bounds is not None
+              and use_decode_kernel(config.attention_impl, kq_c.shape[2])):
+            # decode reads the cache: the q8 kernel consumes int8 + scales
+            # natively — the whole point of the quantized cache. Gated on the
+            # same impl resolution as the exact kernel, so
+            # attention_impl="xla" stays a working escape hatch on TPU
+            from nanorlhf_tpu.ops.decode_attention import decode_attention_q8
+
+            start, filled = decode_bounds
+            out = decode_attention_q8(q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c,
+                                      start, filled)[:, :, None, :]
+        else:
+            # correctness fallback (CPU tests): dequantize and reuse the
+            # exact path — no bandwidth win off-TPU, none needed
+            out = gqa_attention(
+                q, _dequantize_kv(kq_c, ks_c, q.dtype),
+                _dequantize_kv(vq_c, vs_c, q.dtype), mask,
+            )
     elif kv_cache is not None:
         k_cache, v_cache = kv_cache
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
@@ -289,17 +322,19 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
         x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
         return x, None
     else:
+        # cache is a tuple of stacked arrays: (k, v) exact, or
+        # (k_q, k_s, v_q, v_s) int8 — threaded generically through the scan
         def body(carry, inp):
-            layer_params, lora_layer, k_cache, v_cache = inp
+            layer_params, lora_layer = inp[0], inp[1]
             y, new_cache = _layer_body(
-                config, carry, layer_params, cos, sin, mask, (k_cache, v_cache),
+                config, carry, layer_params, cos, sin, mask, tuple(inp[2:]),
                 cache_index, lora_layer, lora_scale,
                 decode_bounds=decode_bounds,
             )
             return y, new_cache
 
         x, new_caches = jax.lax.scan(
-            body, x, (params["layers"], lora_layers, kv_caches[0], kv_caches[1])
+            body, x, (params["layers"], lora_layers, *kv_caches)
         )
         return x, new_caches
 
@@ -439,8 +474,16 @@ def score_forward(
 
 def init_kv_cache(
     config: ModelConfig, batch: int, max_len: int, dtype=jnp.bfloat16
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Stacked KV cache: (k, v), each [L, B, KV, max_len, hd]."""
+) -> tuple[jnp.ndarray, ...]:
+    """Stacked KV cache.
+
+    Exact: (k, v), each [L, B, KV, max_len, hd].
+    kv_cache_quant="int8": (k_q, k_s, v_q, v_s) — int8 values plus f32
+    per-token-per-head scales carried SUBLANE-EXPANDED as [L, B, KV, 8,
+    max_len] so the decode kernel's scale blocks satisfy Mosaic's (8, 128)
+    tiling rule with the sequence on the lane axis (same recipe as the
+    flash kernel's mask, ops/attention.py).
+    """
     shape = (
         config.num_hidden_layers,
         batch,
@@ -448,7 +491,41 @@ def init_kv_cache(
         max_len,
         config.actual_head_dim,
     )
+    if config.kv_cache_quant == "int8":
+        sshape = shape[:3] + (8, max_len)
+        return (
+            jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.bfloat16),
+            jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.bfloat16),
+        )
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, KV, T, hd] -> (int8 [B, KV, T, hd], bf16 scales [B, KV, 8, T]).
+
+    Scales are STORED bf16 (the sublane-replicated layout already costs 8x,
+    so dtype is where the scale stream's bandwidth goes) and quantization
+    divides by the bf16-ROUNDED scale, keeping dequantization exact with
+    respect to what the cache actually holds.
+    """
+    B, KV, T, hd = x.shape
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)                 # [B, KV, T]
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    scale = scale.astype(jnp.bfloat16)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None].astype(jnp.float32)), -127, 127
+    ).astype(jnp.int8)
+    scale8 = jnp.broadcast_to(scale[:, :, None, :], (B, KV, 8, T))
+    return q, scale8
+
+
+def _dequantize_kv(q: jnp.ndarray, scale8: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of _quantize_kv (XLA fallback path)."""
+    return (
+        q.astype(jnp.float32)
+        * scale8[:, :, 0, :, None].astype(jnp.float32)
+    ).astype(dtype)
 
 
 def prefill(

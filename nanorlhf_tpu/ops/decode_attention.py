@@ -111,6 +111,146 @@ def _decode_kernel(start_ref, filled_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def reference_decode_attention_q8(q, k_q, k_s, v_q, v_s, start, filled):
+    """XLA oracle for the int8-cache kernel: dequantize, then the exact
+    reference. k_q/v_q: [B, KV, T, hd] int8; k_s/v_s: [B, KV, 8, T] bf16
+    (sublane-expanded scales, core/model.init_kv_cache)."""
+    dt = q.dtype
+    k = (k_q.astype(jnp.float32) * k_s[:, :, 0, :, None]).astype(dt)
+    v = (v_q.astype(jnp.float32) * v_s[:, :, 0, :, None]).astype(dt)
+    return reference_decode_attention(q, k, v, start, filled)
+
+
+def _decode_q8_kernel(start_ref, filled_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                      vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                      *, scale: float, block_k: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_blk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+    filled = filled_ref[b]
+    first_blk = start // block_k
+    last_blk = (filled - 1) // block_k
+    actual_j = jnp.minimum(first_blk + j, last_blk)
+
+    @pl.when(first_blk + j <= last_blk)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [Gp, hd]
+        k = kq_ref[0, 0].astype(jnp.float32)             # [block_k, hd] int8→f32
+        v = vq_ref[0, 0].astype(jnp.float32)
+        ks = ks_ref[0, 0][:1, :]                         # [1, block_k]
+        vs = vs_ref[0, 0][:1, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale * ks                                   # fold k scales into the score row
+        pos = actual_j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where((pos >= start) & (pos < filled), s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        # fold v scales into the probability row: Σ p·(v_q·vs) = (p·vs)@v_q
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p * vs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_blk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_attention_q8(
+    q: jnp.ndarray,      # [B, H, hd] — single decode position
+    k_q: jnp.ndarray,    # [B, KV, T_max, hd] int8
+    k_s: jnp.ndarray,    # [B, KV, 8, T_max] f32 sublane-expanded scales
+    v_q: jnp.ndarray,    # [B, KV, T_max, hd] int8
+    v_s: jnp.ndarray,    # [B, KV, 8, T_max] f32
+    start: jnp.ndarray,  # [B] int32
+    filled: jnp.ndarray, # [B] int32
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Prefix-bounded decode attention over the int8 KV cache. int8 value
+    blocks + bf16 scale rows stream HBM→VMEM at 144/256 of the exact cache's
+    bytes (hd=128); dequantization is two row-broadcast multiplies folded
+    into the existing online-softmax math. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    KV, T = k_q.shape[1], k_q.shape[2]
+    G = H // KV
+    Gp = max(8, G)
+    block_k = min(block_k, max(128, 128 * pl.cdiv(T, 128)))
+
+    qg = q.reshape(B, KV, G, hd)
+    if Gp != G:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Gp - G), (0, 0)])
+
+    if T % block_k != 0:
+        pad_t = block_k * pl.cdiv(T, block_k) - T
+        k_q = jnp.pad(k_q, [(0, 0), (0, 0), (0, pad_t), (0, 0)])
+        v_q = jnp.pad(v_q, [(0, 0), (0, 0), (0, pad_t), (0, 0)])
+        k_s = jnp.pad(k_s, [(0, 0), (0, 0), (0, 0), (0, pad_t)])
+        v_s = jnp.pad(v_s, [(0, 0), (0, 0), (0, 0), (0, pad_t)])
+        T = T + pad_t
+    n_blk = T // block_k
+
+    kernel = functools.partial(
+        _decode_q8_kernel, scale=1.0 / (hd ** 0.5), block_k=block_k
+    )
+
+    def kv_index_map(b, kv, j, start_ref, filled_ref):
+        first = start_ref[b] // block_k
+        last = (filled_ref[b] - 1) // block_k
+        return (b, kv, jnp.minimum(first + j, last), 0)
+
+    def scale_index_map(b, kv, j, start_ref, filled_ref):
+        first = start_ref[b] // block_k
+        last = (filled_ref[b] - 1) // block_k
+        return (b, kv, 0, jnp.minimum(first + j, last))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, n_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+            pl.BlockSpec((1, 1, 8, block_k), scale_index_map),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+            pl.BlockSpec((1, 1, 8, block_k), scale_index_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Gp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Gp, hd), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Gp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), filled.astype(jnp.int32), qg, k_q, k_s, v_q, v_s)
+    return out[:, :, :G, :].reshape(B, H, hd)
+
+
 def decode_attention(
     q: jnp.ndarray,        # [B, H, hd] — single decode position
     k_cache: jnp.ndarray,  # [B, KV, T_max, hd]

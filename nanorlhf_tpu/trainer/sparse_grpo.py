@@ -300,7 +300,7 @@ class SparseGRPOTrainer(RLTrainer):
             """DISPATCH one rollout (async — nothing blocks until fetched)."""
             q_j = jnp.asarray(queries)
             gen_out = generate(
-                self._rollout_params(), self.mcfg, q_j, q_j != pad_id, gk,
+                self._rollout_params(), self._rollout_mcfg, q_j, q_j != pad_id, gk,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
             )

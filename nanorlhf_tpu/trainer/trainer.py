@@ -266,6 +266,16 @@ class RLTrainer:
             self._refresh_quant_layers()
         elif config.rollout_quant != "none":
             raise ValueError(f"rollout_quant={config.rollout_quant!r}")
+        # int8 KV cache: a rollout-only ModelConfig variant — scoring/update
+        # paths keep the exact config (they never build a cache)
+        if config.kv_cache_quant not in ("none", "int8"):
+            raise ValueError(f"kv_cache_quant={config.kv_cache_quant!r}")
+        import dataclasses as _dc
+
+        self._rollout_mcfg = (
+            _dc.replace(self.mcfg, kv_cache_quant=config.kv_cache_quant)
+            if config.kv_cache_quant != self.mcfg.kv_cache_quant else self.mcfg
+        )
         # opt_steps counts ACTUAL optimizer.update calls — the schedule index
         # for the `lr` metric (a derived formula drifts when the minibatch
         # loop doesn't divide evenly)
@@ -678,7 +688,7 @@ class RLTrainer:
             prompt_mask = queries_j != pad_id
             gen_params = self._rollout_params()
             gen_out = generate(
-                gen_params, self.mcfg, queries_j, prompt_mask, gen_key,
+                gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
             )                                               # [B*n, T]
@@ -686,7 +696,7 @@ class RLTrainer:
             if self.algo == AlgoName.REMAX:
                 # extra greedy rollout as baseline (`ReMax/remax_trainer.py:166-185`)
                 greedy = generate(
-                    gen_params, self.mcfg, queries_j, prompt_mask, gen_key,
+                    gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                     SamplingParams(greedy=True, max_tokens=cfg.response_length),
                     eos_token_id=eos_id, pad_token_id=pad_id,
                     lora_scale=self.lora_scale,
