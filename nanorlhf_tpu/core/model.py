@@ -132,6 +132,16 @@ def use_decode_kernel(impl: str, cache_len: int) -> bool:
             and jax.default_backend() == "tpu")
 
 
+def use_q8_decode_kernel(impl: str) -> bool:
+    """int8-cache decode routing. Unlike the exact case there is no length
+    threshold: the only alternative is the dequantize-everything fallback,
+    which re-materializes the full cache per layer per step and is strictly
+    worse than both the q8 kernel and the unquantized path — so on TPU every
+    non-"xla" impl takes the kernel at any cache length ("xla" stays the
+    operator escape hatch; "pallas" also exercises it in interpret mode)."""
+    return impl == "pallas" or (impl != "xla" and jax.default_backend() == "tpu")
+
+
 def gqa_attention(
     q: jnp.ndarray,       # [B, H, Tq, hd]
     k: jnp.ndarray,       # [B, KV, Tk, hd]
@@ -234,11 +244,11 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         elif T > 1:
             out = gqa_attention(q, k, v, mask[..., :T])
         elif (decode_bounds is not None
-              and use_decode_kernel(config.attention_impl, kq_c.shape[2])):
+              and use_q8_decode_kernel(config.attention_impl)):
             # decode reads the cache: the q8 kernel consumes int8 + scales
-            # natively — the whole point of the quantized cache. Gated on the
-            # same impl resolution as the exact kernel, so
-            # attention_impl="xla" stays a working escape hatch on TPU
+            # natively — the whole point of the quantized cache.
+            # attention_impl="xla" stays a working escape hatch (dequant
+            # fallback below: correct, no bandwidth win)
             from nanorlhf_tpu.ops.decode_attention import decode_attention_q8
 
             start, filled = decode_bounds
@@ -478,11 +488,14 @@ def init_kv_cache(
     """Stacked KV cache.
 
     Exact: (k, v), each [L, B, KV, max_len, hd].
-    kv_cache_quant="int8": (k_q, k_s, v_q, v_s) — int8 values plus f32
+    kv_cache_quant="int8": (k_q, k_s, v_q, v_s) — int8 values plus bf16
     per-token-per-head scales carried SUBLANE-EXPANDED as [L, B, KV, 8,
-    max_len] so the decode kernel's scale blocks satisfy Mosaic's (8, 128)
-    tiling rule with the sequence on the lane axis (same recipe as the
-    flash kernel's mask, ops/attention.py).
+    max_len]: the decode kernel's (1, 1, 8, block_k) scale blocks are
+    Mosaic-legal because the 8 SPANS its array dimension (the
+    equal-to-the-dim clause; bf16's native sublane tile is 16, so the
+    divisibility clause alone would not cover it), with the sequence on
+    the lane axis — same recipe as the flash kernel's mask
+    (ops/attention.py).
     """
     shape = (
         config.num_hidden_layers,

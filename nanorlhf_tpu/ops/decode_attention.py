@@ -180,9 +180,9 @@ def _decode_q8_kernel(start_ref, filled_ref, q_ref, kq_ref, ks_ref, vq_ref,
 def decode_attention_q8(
     q: jnp.ndarray,      # [B, H, hd] — single decode position
     k_q: jnp.ndarray,    # [B, KV, T_max, hd] int8
-    k_s: jnp.ndarray,    # [B, KV, 8, T_max] f32 sublane-expanded scales
+    k_s: jnp.ndarray,    # [B, KV, 8, T_max] bf16 sublane-expanded scales
     v_q: jnp.ndarray,    # [B, KV, T_max, hd] int8
-    v_s: jnp.ndarray,    # [B, KV, 8, T_max] f32
+    v_s: jnp.ndarray,    # [B, KV, 8, T_max] bf16
     start: jnp.ndarray,  # [B] int32
     filled: jnp.ndarray, # [B] int32
     block_k: int = 512,

@@ -70,6 +70,12 @@ class SamplingParams:
     # in the reference's vLLM path too). Set False for the exact candidate
     # set (full-sort cost on TPU).
     approx_top_k: bool = True
+    # >0 enables compacting decode (sampler/compaction.py): the loop runs in
+    # this many segments, and between segments finished rows are flushed and
+    # live rows gathered into a smaller power-of-two batch — the
+    # static-shape analogue of vLLM's continuous batching. 0 = monolithic
+    # single-jit loop (bit-stable row streams, fully async dispatch).
+    compaction_segments: int = 0
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -166,6 +172,39 @@ def generate_tokens(
 ) -> jnp.ndarray:
     """Core jitted loop: one sample per row. Returns [B, max_tokens] int32,
     or (tokens, logprobs [B, max_tokens] f32) with capture_logprobs."""
+    Tp = prompt_ids.shape[1]
+    state = _prefill_state(
+        params, config, prompt_ids, prompt_mask, key,
+        max_tokens=max_tokens, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
+        greedy=greedy, lora_scale=lora_scale, top_k=top_k,
+        capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+    )
+
+    def cond(state):
+        return (state[0] < max_tokens) & ~jnp.all(state[5])
+
+    def body(state):
+        return _decode_body(
+            params, config, state, Tp=Tp, max_tokens=max_tokens,
+            eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+            temperature=temperature, top_p=top_p, greedy=greedy,
+            lora_scale=lora_scale, top_k=top_k,
+            capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+        )
+
+    _, out, lp_out, _, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
+    return (out, lp_out) if capture_logprobs else out
+
+
+def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
+                   max_tokens, eos_token_id, pad_token_id, temperature,
+                   top_p, greedy, lora_scale, top_k, capture_logprobs,
+                   approx_top_k):
+    """Prefill + first sampled token → the decode-loop carry state:
+    (step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key).
+    Per-step sampling keys are fold_in(key, step), so a segment boundary
+    (compaction.py) resumes the identical stream."""
     B, Tp = prompt_ids.shape
     T_max = Tp + max_tokens
     prompt_mask = prompt_mask.astype(bool)
@@ -180,44 +219,42 @@ def generate_tokens(
 
     out0 = jnp.full((B, max_tokens), pad_token_id, jnp.int32)
     lp0 = jnp.zeros((B, max_tokens), jnp.float32)
-    key, k0 = jax.random.split(key)
-    tok0 = _sample_token(k0, first_logits, temperature, top_p, greedy,
-                         top_k, approx_top_k)
+    tok0 = _sample_token(jax.random.fold_in(key, 0), first_logits, temperature,
+                         top_p, greedy, top_k, approx_top_k)
     out0 = out0.at[:, 0].set(tok0)
     if capture_logprobs:
         lp0 = lp0.at[:, 0].set(_token_logprob(first_logits, tok0, temperature))
     done0 = tok0 == eos_token_id
+    return (jnp.int32(1), out0, lp0, caches, key_mask0, done0, tok0,
+            prompt_len, key)
 
-    def cond(state):
-        step, _, _, _, _, done, _, _ = state
-        return (step < max_tokens) & ~jnp.all(done)
 
-    def body(state):
-        step, out, lp_out, caches, key_mask, done, cur_tok, key = state
-        # write current token's KV at cache slot Tp + step - 1 ... wait: token t
-        # sampled from logits at position prompt_len + step - 1; feed it in now.
-        cache_slot = Tp + step - 1
-        key_mask = key_mask.at[:, cache_slot].set(True)  # current slot becomes visible
-        position = prompt_len + step - 1
-        logits, caches = decode_step(
-            params, config, cur_tok, position, cache_slot, key_mask, caches,
-            lora_scale=lora_scale,
-        )
-        key, k = jax.random.split(key)
-        tok = _sample_token(k, logits, temperature, top_p, greedy,
-                            top_k, approx_top_k)
-        tok = jnp.where(done, pad_token_id, tok)
-        write = (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None]
-        out = jnp.where(write, tok[:, None], out)
-        if capture_logprobs:
-            lp = _token_logprob(logits, tok, temperature)
-            lp_out = jnp.where(write, lp[:, None], lp_out)
-        done = done | (tok == eos_token_id)
-        return step + 1, out, lp_out, caches, key_mask, done, tok, key
-
-    state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0, tok0, key)
-    _, out, lp_out, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
-    return (out, lp_out) if capture_logprobs else out
+def _decode_body(params, config, state, *, Tp, max_tokens, eos_token_id,
+                 pad_token_id, temperature, top_p, greedy, lora_scale, top_k,
+                 capture_logprobs, approx_top_k):
+    """One decode step over the carry state (shared by the monolithic
+    while_loop above and the segmented/compacting loop)."""
+    step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key = state
+    # token t was sampled from logits at position prompt_len + step - 1;
+    # its KV lands in cache slot Tp + step - 1
+    cache_slot = Tp + step - 1
+    key_mask = key_mask.at[:, cache_slot].set(True)  # current slot becomes visible
+    position = prompt_len + step - 1
+    logits, caches = decode_step(
+        params, config, cur_tok, position, cache_slot, key_mask, caches,
+        lora_scale=lora_scale,
+    )
+    tok = _sample_token(jax.random.fold_in(key, step), logits, temperature,
+                        top_p, greedy, top_k, approx_top_k)
+    tok = jnp.where(done, pad_token_id, tok)
+    write = (jnp.arange(max_tokens) == step)[None, :] & ~done[:, None]
+    out = jnp.where(write, tok[:, None], out)
+    if capture_logprobs:
+        lp = _token_logprob(logits, tok, temperature)
+        lp_out = jnp.where(write, lp[:, None], lp_out)
+    done = done | (tok == eos_token_id)
+    return (step + 1, out, lp_out, caches, key_mask, done, tok,
+            prompt_len, key)
 
 
 def generate(
@@ -236,6 +273,18 @@ def generate(
     if sampling.n > 1:
         prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
         prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
+    if sampling.compaction_segments > 0:
+        from nanorlhf_tpu.sampler.compaction import generate_tokens_compact
+
+        return generate_tokens_compact(
+            params, config, prompt_ids, prompt_mask, key,
+            max_tokens=sampling.max_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, segments=sampling.compaction_segments,
+            temperature=sampling.temperature, top_p=sampling.top_p,
+            greedy=sampling.greedy, lora_scale=lora_scale,
+            top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
+            approx_top_k=sampling.approx_top_k,
+        )
     return generate_tokens(
         params,
         config,

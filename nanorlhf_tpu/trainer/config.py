@@ -136,6 +136,14 @@ class RLConfig:
     # (scoring/training have no cache); same off-policy-tolerance story as
     # rollout_quant.
     kv_cache_quant: str = "none"  # none | int8
+    # >0: rollouts use compacting decode (sampler/compaction.py) with this
+    # many segments — finished rows are flushed at segment boundaries and
+    # live rows gathered into a smaller power-of-two batch, so stragglers
+    # stop paying full-batch decode steps (the static-shape analogue of
+    # vLLM's continuous batching). Costs one compile per distinct batch
+    # size (cached) and a host sync per segment; see the compaction module
+    # docstring for the rollout_ahead interaction.
+    rollout_compaction_segments: int = 0
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1

@@ -290,6 +290,7 @@ class SparseGRPOTrainer(RLTrainer):
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,
+            compaction_segments=cfg.rollout_compaction_segments,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])

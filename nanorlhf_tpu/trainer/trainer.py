@@ -660,6 +660,7 @@ class RLTrainer:
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,
+            compaction_segments=cfg.rollout_compaction_segments,
         )
 
         # after a resume, the default budget is the REMAINING updates, not a
