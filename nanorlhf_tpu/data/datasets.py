@@ -181,6 +181,7 @@ def load_prompt_dataset(
     limit: int | None = None,
     seed: int = 0,
     num_proc: int | None = None,
+    cache_dir: str | None = None,
 ) -> PromptDataset:
     """hh-rlhf-style prompt dataset; `synthetic:<n>` for the offline corpus.
 
@@ -188,7 +189,29 @@ def load_prompt_dataset(
     (multiprocess/batched, `num_proc` as `dataset.map(num_proc=6)`) and
     left-pads to the batch max — matching the reference's pre-tokenized
     dataloader contract.
+
+    `cache_dir` enables the native token cache (`data/token_cache.py`) —
+    the Arrow-cache role `dataset.map` plays for the reference: re-launches
+    with identical (source, split, limit, seed, max len, tokenizer) mmap
+    the encoded corpus instead of re-tokenizing it.
     """
+    cache_path = fp = None
+    if cache_dir is not None:
+        from nanorlhf_tpu.data.token_cache import (
+            corpus_fingerprint, load_token_cache, save_token_cache,
+            tokenizer_identity)
+
+        fp = corpus_fingerprint(
+            name=name, split=split, limit=limit, seed=seed,
+            max_prompt_len=max_prompt_len, tok=tokenizer_identity(tokenizer),
+        )
+        cache_path = os.path.join(cache_dir, f"prompts-{fp:016x}.tok")
+        cached = load_token_cache(cache_path, fp)
+        if cached is not None:
+            return PromptDataset(
+                _left_pad(cached, tokenizer.pad_token_id), tokenizer.pad_token_id
+            )
+
     if name.startswith("synthetic"):
         _, _, count = name.partition(":")
         texts = synthetic_prompts(int(count) if count else 512, tokenizer, seed)
@@ -206,4 +229,6 @@ def load_prompt_dataset(
         for t in texts
     ]
     ids = encode_texts(tokenizer, templated, max_prompt_len, num_proc=num_proc)
+    if cache_path is not None:
+        save_token_cache(cache_path, ids, fp)
     return PromptDataset(_left_pad(ids, tokenizer.pad_token_id), tokenizer.pad_token_id)

@@ -25,7 +25,8 @@ def _build_and_load():
     if _TRIED:
         return _LIB
     _TRIED = True
-    src = os.path.join(os.path.dirname(__file__), "bucketing.cpp")
+    here = os.path.dirname(__file__)
+    srcs = [os.path.join(here, f) for f in ("bucketing.cpp", "token_cache.cpp")]
     cache_dir = os.path.join(
         os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
         "nanorlhf_tpu",
@@ -34,12 +35,12 @@ def _build_and_load():
     so_path = os.path.join(cache_dir, "libnanorlhf_native.so")
     try:
         if (not os.path.exists(so_path)
-                or os.path.getmtime(so_path) < os.path.getmtime(src)):
+                or os.path.getmtime(so_path) < max(map(os.path.getmtime, srcs))):
             # pid-unique tmp: concurrent processes (pytest workers, multi-host
             # launchers sharing $HOME) must not clobber each other mid-write
             tmp_path = f"{so_path}.{os.getpid()}.tmp"
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o",
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", *srcs, "-o",
                  tmp_path],
                 check=True, capture_output=True, timeout=120,
             )
@@ -57,6 +58,26 @@ def _build_and_load():
                 ctypes.c_int, ctypes.c_int, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int32),
             ]
+        lib.token_cache_write.restype = ctypes.c_int
+        lib.token_cache_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+        ]
+        lib.token_cache_stat.restype = ctypes.c_int
+        lib.token_cache_stat.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.token_cache_open.restype = ctypes.c_int
+        lib.token_cache_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.token_cache_close.restype = None
+        lib.token_cache_close.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _LIB = lib
     except Exception as e:  # missing toolchain etc. → Python fallback
         detail = ""
@@ -120,3 +141,89 @@ def pack_left_pad_native(rows, max_len: int, pad_id: int):
 
 def pack_right_pad_native(rows, max_len: int, pad_id: int):
     return _pack(rows, max_len, pad_id, left=False)
+
+
+# --------------------------------------------------------------------------
+# Token-cache file (token_cache.cpp): mmap-backed tokenized-corpus cache
+# --------------------------------------------------------------------------
+
+
+class TokenCacheView:
+    """Zero-copy view over an open native token cache. `offsets` and `flat`
+    are numpy arrays aliasing the mmap — valid until `close()`."""
+
+    def __init__(self, base, length, offsets, flat, n_rows):
+        self._base, self._len = base, length
+        self.offsets, self.flat, self.n_rows = offsets, flat, n_rows
+
+    def row(self, i: int) -> np.ndarray:
+        return self.flat[self.offsets[i]:self.offsets[i + 1]]
+
+    def close(self):
+        lib = _build_and_load()
+        if lib is not None and self._base:
+            lib.token_cache_close(self._base, self._len)
+            self._base = None
+
+    def __del__(self):  # cache-hit loads must not leak the mapping
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: module globals may be gone
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def flatten_rows(rows) -> tuple[np.ndarray, np.ndarray]:
+    """(offsets int64 [n+1], flat int32) for a ragged corpus — the ONE
+    flattening both cache writers share (the C++/Python interop guarantee
+    rests on the two writers producing identical bytes)."""
+    lens = np.asarray([len(r) for r in rows], np.int64)
+    offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(r, np.int32) for r in rows])
+        if len(rows) and offsets[-1] else np.empty(0, np.int32)
+    )
+    return offsets, flat
+
+
+def token_cache_write_native(path: str, rows, fingerprint: int) -> bool:
+    """Write a ragged int32 corpus to the cache file (atomic). False w/o lib."""
+    lib = _build_and_load()
+    if lib is None:
+        return False
+    offsets, flat = flatten_rows(rows)
+    rc = lib.token_cache_write(
+        path.encode(), flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(rows), ctypes.c_uint64(fingerprint & (2**64 - 1)),
+    )
+    return rc == 0
+
+
+def token_cache_open_native(path: str, fingerprint: int) -> TokenCacheView | None:
+    """mmap an existing cache; None on missing/corrupt/fingerprint mismatch."""
+    lib = _build_and_load()
+    if lib is None or not os.path.exists(path):
+        return None
+    base = ctypes.c_void_p()
+    length = ctypes.c_int64()
+    off_p = ctypes.POINTER(ctypes.c_int64)()
+    flat_p = ctypes.POINTER(ctypes.c_int32)()
+    n_rows = ctypes.c_int64()
+    rc = lib.token_cache_open(
+        path.encode(), ctypes.c_uint64(fingerprint & (2**64 - 1)),
+        ctypes.byref(base), ctypes.byref(length), ctypes.byref(off_p),
+        ctypes.byref(flat_p), ctypes.byref(n_rows),
+    )
+    if rc != 0:
+        return None
+    n = n_rows.value
+    offsets = np.ctypeslib.as_array(off_p, shape=(n + 1,))
+    flat = np.ctypeslib.as_array(flat_p, shape=(int(offsets[n]),)) \
+        if offsets[n] else np.empty(0, np.int32)
+    return TokenCacheView(base, length.value, offsets, flat, n)

@@ -217,12 +217,15 @@ def decode_attention_q8(
 
     def kv_index_map(b, kv, j, start_ref, filled_ref):
         first = start_ref[b] // block_k
-        last = (filled_ref[b] - 1) // block_k
+        # max(last, 0): filled==0 (no valid slots) would map to block -1 —
+        # the @pl.when guard already skips compute, but the prefetch index
+        # must still be in range
+        last = jnp.maximum((filled_ref[b] - 1) // block_k, 0)
         return (b, kv, jnp.minimum(first + j, last), 0)
 
     def scale_index_map(b, kv, j, start_ref, filled_ref):
         first = start_ref[b] // block_k
-        last = (filled_ref[b] - 1) // block_k
+        last = jnp.maximum((filled_ref[b] - 1) // block_k, 0)
         return (b, kv, 0, jnp.minimum(first + j, last))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -284,7 +287,10 @@ def decode_attention(
 
     def kv_index_map(b, kv, j, start_ref, filled_ref):
         first = start_ref[b] // block_k
-        last = (filled_ref[b] - 1) // block_k
+        # max(last, 0): filled==0 (no valid slots) would map to block -1 —
+        # the @pl.when guard already skips compute, but the prefetch index
+        # must still be in range
+        last = jnp.maximum((filled_ref[b] - 1) // block_k, 0)
         return (b, kv, jnp.minimum(first + j, last), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

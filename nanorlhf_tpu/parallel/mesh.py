@@ -35,22 +35,56 @@ class MeshConfig:
     fsdp: int = 1
     tensor: int = 1
     sp: int = 1         # sequence-parallel extent (ring attention)
+    # number of TPU slices the DATA axis spans (multi-slice / DCN scaling).
+    # The data axis becomes (dcn_data × per-slice data) with slices
+    # slowest-varying, so fsdp/tensor/sp collectives stay inside a slice
+    # (ICI) and only the once-per-update gradient psum crosses DCN — the
+    # layout §5.8 calls for. 1 = single slice (no DCN traffic at all).
+    dcn_data: int = 1
 
     def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
         d, f, t, s = self.data, self.fsdp, self.tensor, self.sp
+        dcn = max(self.dcn_data, 1)
         known = (f if f > 0 else 1) * (t if t > 0 else 1) * (s if s > 0 else 1)
         if d == -1:
-            d = n_devices // known
+            d = n_devices // (known * dcn) * dcn
         if d * f * t * s != n_devices:
             raise ValueError(
                 f"mesh {d}x{f}x{t}x{s} != {n_devices} devices"
             )
+        if d % dcn != 0:
+            raise ValueError(f"data axis {d} not divisible by dcn_data {dcn}")
         return d, f, t, s
+
+
+def _slice_ordered(devices, dcn: int):
+    """Order devices slice-major so reshaping puts whole slices on the
+    leading (DCN) part of the data axis. TPU runtimes expose `slice_index`
+    on each device — when present, the physical layout must actually match
+    `dcn` (distinct slices == dcn, equal sizes), else fsdp/tensor/sp
+    collectives would silently straddle slice boundaries and cross DCN
+    every layer. Hosts without `slice_index` (CPU test meshes) fall back to
+    id order, which partitions the virtual devices into `dcn` contiguous
+    groups — same axis semantics, no physical slices to respect."""
+    if all(hasattr(d, "slice_index") for d in devices):
+        slices = sorted({d.slice_index for d in devices})
+        if len(slices) != dcn:
+            raise ValueError(
+                f"dcn_data={dcn} but devices span {len(slices)} slices"
+            )
+        per = [sum(d.slice_index == s for d in devices) for s in slices]
+        if len(set(per)) != 1:
+            raise ValueError(f"uneven devices per slice: {per}")
+        return sorted(devices, key=lambda dev: (dev.slice_index, dev.id))
+    return sorted(devices, key=lambda dev: dev.id)
 
 
 def make_mesh(config: MeshConfig = MeshConfig(), devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     d, f, t, s = config.resolve(len(devices))
+    dcn = max(config.dcn_data, 1)
+    if dcn > 1:
+        devices = _slice_ordered(devices, dcn)
     arr = np.asarray(devices).reshape(d, f, t, s)
     return Mesh(arr, ("data", "fsdp", "tensor", "sp"))
 

@@ -53,6 +53,9 @@ _prefill_state_jit = partial(
                      "pad_token_id", "temperature", "top_p", "greedy",
                      "lora_scale", "top_k", "capture_logprobs",
                      "approx_top_k"),
+    # donate the carry so XLA aliases the KV-cache buffers across segment
+    # boundaries instead of holding two full copies of the cache live
+    donate_argnums=(2,),
 )
 def _decode_segment(params, config, state, seg_end, *, Tp, max_tokens,
                     eos_token_id, pad_token_id, temperature, top_p, greedy,
@@ -74,7 +77,10 @@ def _decode_segment(params, config, state, seg_end, *, Tp, max_tokens,
     return jax.lax.while_loop(cond, body, state)
 
 
-@jax.jit
+# donation can't alias (the output batch is smaller) but frees the old
+# cache as soon as the gather has consumed it, instead of holding both
+# copies until the host drops its reference
+@partial(jax.jit, donate_argnums=(0,))
 def _gather_rows(state, idx):
     """Row-gather the whole carry state (caches gather on their batch axis)."""
     step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key = state
@@ -82,6 +88,43 @@ def _gather_rows(state, idx):
     caches = tuple(jnp.take(c, idx, axis=1) for c in caches)  # [L, B, ...]
     return (step, take(out), take(lp_out), caches, take(key_mask),
             take(done), take(cur_tok), take(prompt_len), key)
+
+
+def _shard_state(state, batch_sharding):
+    """Re-lay-out a gathered carry under the caller's batch sharding.
+
+    `jnp.take` inside `_gather_rows` produces outputs under GSPMD's default
+    layout choice, which for a gathered (smaller) batch is typically fully
+    replicated — silently multiplying KV-cache HBM by the device count.
+    Re-device_put each leaf with its batch axis sharded the way the caller
+    shards rollout batches (caches carry batch on axis 1, the rest axis 0)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, batch_axes = batch_sharding.mesh, batch_sharding.spec[0]
+
+    def put(x, axis):
+        spec = [None] * x.ndim
+        spec[axis] = batch_axes
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key = state
+    caches = tuple(put(c, 1) for c in caches)
+    return (step, put(out, 0), put(lp_out, 0), caches, put(key_mask, 0),
+            put(done, 0), put(cur_tok, 0), put(prompt_len, 0), key)
+
+
+def _batch_axis_size(batch_sharding) -> int:
+    """Number of devices the batch axis spans (the gather target must stay a
+    multiple of this or rows can't be laid out evenly)."""
+    axes = batch_sharding.spec[0]
+    if axes is None:
+        return 1
+    if not isinstance(axes, tuple):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= batch_sharding.mesh.shape[a]
+    return size
 
 
 def generate_tokens_compact(
@@ -102,10 +145,20 @@ def generate_tokens_compact(
     top_k: int = 64,
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
+    batch_sharding=None,
 ):
     """Segmented decode with batch compaction. Same output contract as
-    `generate_tokens`; host-orchestrated (syncs once per segment)."""
+    `generate_tokens`; host-orchestrated (syncs once per segment).
+
+    `batch_sharding` (a NamedSharding with the batch axes in spec[0], as
+    produced by `parallel.mesh.batch_sharding`) keeps compaction mesh-aware:
+    the gather target is clamped to a multiple of the batch-axis device
+    count and the gathered carry is re-laid-out under that sharding, so the
+    compacted KV cache stays sharded instead of replicating."""
     B0, Tp = prompt_ids.shape
+    min_batch = _MIN_BATCH
+    if batch_sharding is not None:
+        min_batch = max(min_batch, _batch_axis_size(batch_sharding))
     kw = dict(
         max_tokens=max_tokens, eos_token_id=eos_token_id,
         pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
@@ -139,7 +192,10 @@ def generate_tokens_compact(
         if done.all() or step >= max_tokens:
             break
         live = np.where(~done)[0]
-        target = max(_MIN_BATCH, 1 << (len(live) - 1).bit_length())
+        target = max(min_batch, 1 << (len(live) - 1).bit_length())
+        # a non-power-of-two batch axis (e.g. data*fsdp=12): the pow2 menu
+        # value may not be a multiple of it — round up so rows lay out evenly
+        target = -(-target // min_batch) * min_batch
         if target <= len(done) // 2:
             # flush finished rows, then gather the live ones (+ pad
             # duplicates of live[0], owner -1) into the smaller batch
@@ -151,6 +207,8 @@ def generate_tokens_compact(
             new_owner = owner[idx]
             new_owner[len(live):] = -1
             state = _gather_rows(state, jnp.asarray(idx, jnp.int32))
+            if batch_sharding is not None:
+                state = _shard_state(state, batch_sharding)
             owner = new_owner
             if len(live) < target:
                 # padding duplicates must read as DONE, or they keep sampling

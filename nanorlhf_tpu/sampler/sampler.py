@@ -267,9 +267,13 @@ def generate(
     eos_token_id: int,
     pad_token_id: int,
     lora_scale: float = 1.0,
+    batch_sharding=None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
-    prompt; (tokens, logprobs) when `sampling.capture_logprobs`."""
+    prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
+
+    `batch_sharding` (optional NamedSharding over the batch axes) is only
+    consumed by the compacting path, which re-lays-out gathered carries."""
     if sampling.n > 1:
         prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
         prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
@@ -284,6 +288,7 @@ def generate(
             greedy=sampling.greedy, lora_scale=lora_scale,
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k,
+            batch_sharding=batch_sharding,
         )
     return generate_tokens(
         params,

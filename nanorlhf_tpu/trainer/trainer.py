@@ -683,15 +683,14 @@ class RLTrainer:
                 queries = depad_queries(queries, pad_id, ctx_menu)
             if self._sp_on():
                 self._sp_check_widths(queries.shape[1])
-            queries_j = jax.device_put(
-                jnp.asarray(queries), batch_sharding(self.mesh)
-            )
+            bs = batch_sharding(self.mesh)
+            queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
             gen_params = self._rollout_params()
             gen_out = generate(
                 gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
-                lora_scale=self.lora_scale,
+                lora_scale=self.lora_scale, batch_sharding=bs,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:
@@ -1026,6 +1025,12 @@ class RLTrainer:
         # without stepping, hence the dedicated counter). Without this a
         # resumed run silently re-trains on the first batches. Pre-counter
         # checkpoints fall back to global_step (exact for the dense runtime).
+        # NOTE: under rollout_ahead what's exact is the DATA and PRNG
+        # streams, not the sampled trajectories — the abandoned prefetch had
+        # sampled from the params as of one update before the checkpoint,
+        # while the re-draw samples from the restored (post-update)
+        # params, so the first post-resume rollout is one update fresher
+        # than the uninterrupted run's would have been.
         self.state["rollouts"] = tstate.get("rollouts", tstate["step"])
         self._iter = self.dataset.loader(self.cfg.batch_size, self.cfg.seed) \
             if hasattr(self.dataset, "loader") else iter(self.dataset)

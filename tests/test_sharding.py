@@ -71,3 +71,26 @@ def test_sharded_params_memory_is_distributed():
     shard_shapes = {s.data.shape for s in kernel.addressable_shards}
     L, D, F = kernel.shape
     assert shard_shapes == {(L, D // 4, F // 2)}
+
+
+def test_dcn_mesh_axis():
+    """Multi-slice data axis (MeshConfig.dcn_data): the data axis spans
+    dcn_data x per-slice groups with slices slowest-varying, so a gradient
+    psum over 'data' is the only collective that would cross DCN. On the
+    CPU mesh the 8 virtual devices partition into contiguous groups (no
+    slice_index attr) — axis semantics identical."""
+    cfg = MeshConfig(data=-1, fsdp=2, dcn_data=2)
+    assert cfg.resolve(8) == (4, 2, 1, 1)
+    mesh = make_mesh(cfg)
+    assert mesh.shape == {"data": 4, "fsdp": 2, "tensor": 1, "sp": 1}
+    # slice-major: first half of the data axis = first device group
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert set(ids[:2].flatten().tolist()) == set(range(4))
+    # a batch-sharded matmul still runs (collectives compile + execute)
+    x = jax.device_put(jnp.ones((8, 16)), batch_sharding(mesh))
+    w = jax.device_put(jnp.ones((16, 4)),
+                       NamedSharding(mesh, P(None, "tensor")))
+    y = jax.jit(lambda x, w: x @ w)(x, w)
+    np.testing.assert_allclose(np.asarray(y), 16.0)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, dcn_data=2).resolve(3)
