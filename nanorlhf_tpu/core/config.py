@@ -47,6 +47,20 @@ class ModelConfig:
     #   always resolve to XLA (interpret-mode Pallas is a test vehicle, not
     #   an execution path).
     attention_impl: str = "auto"
+    # SPMD hints for the Pallas kernels. GSPMD has no partitioning rule for
+    # a custom call: without these, a batch-sharded training/rollout step
+    # ALL-GATHERS the kernel operands (q/k/v, the whole KV cache) onto every
+    # device and replicates the output — silently, observed in compiled HLO.
+    # When `spmd_mesh` is set, the kernel call sites wrap themselves in
+    # shard_map over the batch dim (axes in `spmd_batch_axes` that are >1 in
+    # the mesh) and, where head counts divide, the head dim over
+    # `spmd_head_axis` — each device then runs the kernel on its own shard,
+    # which is the whole point of the kernels. The trainer sets these from
+    # its mesh automatically; None = single-device behavior (no wrap).
+    # (Mesh is hashable, so this stays a valid static jit argument.)
+    spmd_mesh: object = None            # jax.sharding.Mesh | None
+    spmd_batch_axes: tuple = ()         # e.g. ("data", "fsdp")
+    spmd_head_axis: Optional[str] = None  # e.g. "tensor"
 
     @property
     def actual_head_dim(self) -> int:

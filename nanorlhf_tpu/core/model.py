@@ -142,6 +142,49 @@ def use_q8_decode_kernel(impl: str) -> bool:
     return impl == "pallas" or (impl != "xla" and jax.default_backend() == "tpu")
 
 
+def _kernel_spmd(config: ModelConfig, H: int, KV: int):
+    """(mesh, batch_axes, head_axis|None) for wrapping a Pallas kernel in
+    shard_map, or None when no multi-device hint applies (single device, or
+    nothing in the config's axes actually spans >1 device)."""
+    mesh = config.spmd_mesh
+    if mesh is None:
+        return None
+    batch = tuple(
+        a for a in config.spmd_batch_axes if mesh.shape.get(a, 1) > 1
+    )
+    head = config.spmd_head_axis
+    hsz = mesh.shape.get(head, 1) if head else 1
+    if hsz <= 1 or H % hsz or KV % hsz:
+        head = None  # uneven heads: replicate them (still fixes the batch)
+    if not batch and head is None:
+        return None
+    return mesh, (batch or None), head
+
+
+def _spmd_call(spmd, fn, args, head_dims):
+    """Run `fn(*args)` under shard_map: batch dim 0 sharded over the batch
+    axes, the head dim (per-arg index in `head_dims`, None = no head dim)
+    over the head axis. Output shards like the first argument. Without this
+    GSPMD must treat the inner pallas_call as an opaque custom call and
+    all-gathers every operand (see ModelConfig.spmd_mesh)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, batch, head = spmd
+
+    def spec(x, hdim):
+        s = [None] * x.ndim
+        s[0] = batch
+        if head is not None and hdim is not None:
+            s[hdim] = head
+        return P(*s)
+
+    in_specs = tuple(spec(x, h) for x, h in zip(args, head_dims))
+    out_specs = spec(args[0], head_dims[0])
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)(*args)
+
+
 def gqa_attention(
     q: jnp.ndarray,       # [B, H, Tq, hd]
     k: jnp.ndarray,       # [B, KV, Tk, hd]
@@ -149,12 +192,14 @@ def gqa_attention(
     mask: jnp.ndarray,    # [B, 1, Tq, Tk] bool, True = attend
     impl: str = "xla",
     mask_is_causal_x_keyvalid: bool = False,
+    spmd=None,
 ) -> jnp.ndarray:
     """`mask_is_causal_x_keyvalid` asserts the mask factors as
     causal(Tq,Tk) & key_valid[B,Tk] — required for the flash path, which
     rebuilds the causal part in-kernel and keeps only the key-validity row.
     Callers with arbitrary masks (prefix-LM etc.) must leave it False and get
-    the general XLA path."""
+    the general XLA path. `spmd` (from `_kernel_spmd`) shard_maps the flash
+    kernel so a sharded batch stays sharded."""
     B, H, Tq, hd = q.shape
     Tk = k.shape[2]
     if use_flash(impl, Tq) and mask_is_causal_x_keyvalid and Tq == Tk and Tq > 1:
@@ -162,6 +207,11 @@ def gqa_attention(
         from nanorlhf_tpu.ops.attention import flash_attention
 
         key_valid = mask[:, 0, -1, :]
+        if spmd is not None:
+            return _spmd_call(
+                spmd, lambda q, k, v, kv: flash_attention(q, k, v, kv, causal=True),
+                (q, k, v, key_valid), (1, 1, 1, None),
+            )
         return flash_attention(q, k, v, key_valid, causal=True)
     KV = k.shape[1]
     G = H // KV
@@ -213,6 +263,7 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
     hd = config.actual_head_dim
     H, KV = config.num_attention_heads, config.num_key_value_heads
     B, T, D = x.shape
+    spmd = _kernel_spmd(config, H, KV)
 
     h = rms_norm(x, layer_params["input_layernorm"], config.rms_norm_eps)
     q = _proj(h, layer_params, lora_layer, "q_proj", lora_scale)
@@ -240,7 +291,7 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         new_cache = (kq_c, ks_c, vq_c, vs_c)
         if T > 1 and use_flash(config.attention_impl, T):
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
-                                mask_is_causal_x_keyvalid=True)
+                                mask_is_causal_x_keyvalid=True, spmd=spmd)
         elif T > 1:
             out = gqa_attention(q, k, v, mask[..., :T])
         elif (decode_bounds is not None
@@ -252,8 +303,12 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             from nanorlhf_tpu.ops.decode_attention import decode_attention_q8
 
             start, filled = decode_bounds
-            out = decode_attention_q8(q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c,
-                                      start, filled)[:, :, None, :]
+            q8_args = (q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c, start, filled)
+            if spmd is not None:
+                out = _spmd_call(spmd, decode_attention_q8, q8_args,
+                                 (1, 1, 1, 1, 1, None, None))[:, :, None, :]
+            else:
+                out = decode_attention_q8(*q8_args)[:, :, None, :]
         else:
             # correctness fallback (CPU tests): dequantize and reuse the
             # exact path — no bandwidth win off-TPU, none needed
@@ -271,22 +326,25 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             # the local-length K/V through the flash kernel instead of the
             # T_max-padded cache
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
-                                mask_is_causal_x_keyvalid=True)
+                                mask_is_causal_x_keyvalid=True, spmd=spmd)
         elif (T == 1 and decode_bounds is not None
               and use_decode_kernel(config.attention_impl, k_cache.shape[2])):
             # decode: prefix-bounded Pallas kernel reads only the filled
             # cache range instead of the masked T_max square
             from nanorlhf_tpu.ops.decode_attention import decode_attention
 
-            start, filled = decode_bounds
-            out = decode_attention(q[:, :, 0, :], k_cache, v_cache,
-                                   start, filled)[:, :, None, :]
+            dec_args = (q[:, :, 0, :], k_cache, v_cache) + tuple(decode_bounds)
+            if spmd is not None:
+                out = _spmd_call(spmd, decode_attention, dec_args,
+                                 (1, 1, 1, None, None))[:, :, None, :]
+            else:
+                out = decode_attention(*dec_args)[:, :, None, :]
         else:
             out = gqa_attention(q, k_cache, v_cache, mask)
     else:
         new_cache = None
         out = gqa_attention(q, k, v, mask, impl=config.attention_impl,
-                            mask_is_causal_x_keyvalid=True)
+                            mask_is_causal_x_keyvalid=True, spmd=spmd)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _proj(out, layer_params, lora_layer, "o_proj", lora_scale)
     x = x + out

@@ -46,9 +46,11 @@ def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "aut
 def resolve_dataset(cfg: RLConfig, tokenizer, max_prompt_len: int = 256):
     """hh-rlhf when the datasets cache has it; synthetic corpus otherwise."""
     name = cfg.train_dataset_name
+    cache = cfg.dataset_cache_dir
     try:
         return load_prompt_dataset(name, tokenizer, split=cfg.train_dataset_split,
-                                   max_prompt_len=max_prompt_len)
+                                   max_prompt_len=max_prompt_len,
+                                   cache_dir=cache)
     except Exception as e:  # zero-egress / no local cache
         print(f"[offline demo] dataset '{name}' unavailable ({type(e).__name__}) — "
               "synthetic prompts")

@@ -23,7 +23,7 @@ import numpy as np
 
 class CheckpointManager:
     def __init__(self, output_dir: str, save_total_limit: int = 8,
-                 greater_is_better: bool = True):
+                 greater_is_better: bool = True, async_save: bool = True):
         self.output_dir = os.path.abspath(output_dir)
         self.save_total_limit = save_total_limit
         self.greater_is_better = greater_is_better
@@ -37,7 +37,20 @@ class CheckpointManager:
         self._load_metric_history()
         import orbax.checkpoint as ocp
 
-        self._ckptr = ocp.PyTreeCheckpointer()
+        # async: save() blocks only for the device→host copy (so the update
+        # step's buffer donation can't race the write), then streams to disk
+        # while training continues — the save disappears from the step wall.
+        # Every read/rotate path waits for the in-flight write first.
+        self._ckptr = (
+            ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            if async_save else ocp.PyTreeCheckpointer()
+        )
+
+    def wait(self):
+        """Block until any in-flight async save has committed to disk."""
+        fn = getattr(self._ckptr, "wait_until_finished", None)
+        if fn is not None:
+            fn()
 
     @property
     def _history_path(self) -> str:
@@ -49,6 +62,18 @@ class CheckpointManager:
                 data = json.load(f)
             self._metric_by_step = {int(k): v for k, v in data.get("metrics", {}).items()}
             self._last_saved_step = data.get("last_saved_step")
+        # history is written while the async tree write streams, so a crash
+        # mid-save can leave it claiming a checkpoint that never committed —
+        # clamp to what's actually on disk, else the next save's metric_old
+        # gets attributed to the phantom step (and best/rotation follow it)
+        committed = {int(d.rsplit("-", 1)[1]) for d in self._ckpt_dirs}
+        latest = max(committed) if committed else None
+        if self._last_saved_step is not None and \
+                self._last_saved_step not in committed:
+            self._last_saved_step = latest
+        self._metric_by_step = {
+            k: v for k, v in self._metric_by_step.items() if k in committed
+        }
 
     def _save_metric_history(self):
         with open(self._history_path, "w") as f:
@@ -60,8 +85,14 @@ class CheckpointManager:
     def _existing(self) -> list[str]:
         if not os.path.isdir(self.output_dir):
             return []
+        # only COMMITTED checkpoints count: orbax finalizes the async tree
+        # write with an atomic tmp-dir rename, so `tree/` exists iff the
+        # write committed — a process that died mid-save leaves a dir this
+        # filter (and therefore latest_step()/resume) ignores
         dirs = [
-            d for d in os.listdir(self.output_dir) if d.startswith("checkpoint-")
+            d for d in os.listdir(self.output_dir)
+            if d.startswith("checkpoint-")
+            and os.path.isdir(os.path.join(self.output_dir, d, "tree"))
         ]
         return sorted(
             (os.path.join(self.output_dir, d) for d in dirs),
@@ -77,6 +108,7 @@ class CheckpointManager:
         if metric_old is not None and self._last_saved_step is not None:
             self._metric_by_step[self._last_saved_step] = float(metric_old)
 
+        self.wait()  # previous async write must commit before we touch disk
         path = os.path.join(self.output_dir, f"checkpoint-{step}")
         shutil.rmtree(path, ignore_errors=True)
         tree = {"params": params}
@@ -133,9 +165,8 @@ class CheckpointManager:
     def restore(self, step: int, like):
         """Restore the pytree saved at `step`, matching the structure/shardings
         of `like` (pass {"params": params_template, ...})."""
+        self.wait()
         path = os.path.join(self.output_dir, f"checkpoint-{step}", "tree")
-        import orbax.checkpoint as ocp
-
         restored = self._ckptr.restore(path, item=like)
         return restored
 
@@ -143,6 +174,7 @@ class CheckpointManager:
         """Drop checkpoints and metric history newer than `step` — called on
         resume-from-an-earlier-step so the abandoned trajectory's saves can't
         hijack latest_step()/best_step() or misattribute the next metric_old."""
+        self.wait()
         for d in list(self._ckpt_dirs):
             if int(d.rsplit("-", 1)[1]) > step:
                 shutil.rmtree(d, ignore_errors=True)
@@ -160,5 +192,15 @@ class CheckpointManager:
             return json.load(f)
 
     def latest_step(self) -> int | None:
+        self.wait()
         dirs = self._existing()
         return int(dirs[-1].rsplit("-", 1)[1]) if dirs else None
+
+    def close(self):
+        """Flush the in-flight save. Call before process exit OR before a
+        successor manager opens the same output_dir: an async write
+        abandoned at teardown is a corrupt checkpoint, and to a successor
+        an unflushed save is indistinguishable from a crash mid-save (its
+        step gets clamped out of the metric history). `RLTrainer.train()`
+        waits on return and `RLTrainer.close()` calls this."""
+        self.wait()

@@ -47,6 +47,9 @@ class RLConfig:
     # ---- data ----
     train_dataset_name: str = "Anthropic/hh-rlhf"   # (`GRPO/grpo.py:101`)
     train_dataset_split: str = "train"              # (`GRPO/grpo.py:102`)
+    # tokenized-corpus cache dir (data/token_cache.py — the Arrow-cache role
+    # `dataset.map` plays for the reference); None disables
+    dataset_cache_dir: Optional[str] = None
 
     # ---- rollout / sampling ----
     response_length: int = 1500          # max new tokens (`GRPO/grpo.py:125`)

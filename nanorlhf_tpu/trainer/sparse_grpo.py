@@ -536,4 +536,6 @@ class SparseGRPOTrainer(RLTrainer):
                     extra_state={"episode": self.state["episode"],
                                  "opt_steps": self.state.get("opt_steps", 0)},
                 )
+        # train() returning implies checkpoints are durable (async saver)
+        self.ckpt.wait()
         return self.state

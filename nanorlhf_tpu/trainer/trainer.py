@@ -170,6 +170,17 @@ class RLTrainer:
         self.algo = config.algo
 
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        # Pallas-kernel SPMD hints (core/config.py spmd_mesh): on a mesh
+        # whose batch/tensor axes span >1 device the kernel call sites must
+        # shard_map themselves or GSPMD all-gathers their operands
+        if (self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
+                * self.mesh.shape.get("tensor", 1)) > 1:
+            import dataclasses as _dc
+
+            self.mcfg = _dc.replace(
+                self.mcfg, spmd_mesh=self.mesh,
+                spmd_batch_axes=("data", "fsdp"), spmd_head_axis="tensor",
+            )
         if config.total_episodes is None:
             # episodes-from-epochs parity (`GRPO/grpo_trainer.py:216-217`)
             if not hasattr(dataset, "__len__"):
@@ -966,6 +977,10 @@ class RLTrainer:
                     value_params=self.value_params if cfg.save_value_model else None,
                 )
 
+        # train() returning implies every checkpoint is DURABLE: flush the
+        # in-flight async save (saves mid-run overlap training; only this
+        # final one blocks)
+        self.ckpt.wait()
         # load_best_model_at_end parity (`GRPO/grpo.py:149`, resolved via the
         # `_old` one-save-back metric semantics, `grpo_trainer.py:374-382`)
         if cfg.load_best_model_at_end and num_updates is None:
@@ -1039,6 +1054,7 @@ class RLTrainer:
         return self.state
 
     def close(self):
+        self.ckpt.close()  # flush any in-flight async checkpoint write
         self.logger.close()
 
     # ------------------------------------------------------------------ #

@@ -32,20 +32,33 @@ import hashlib
 
 
 def _host_fingerprint() -> str:
+    # the jax/jaxlib version pair belongs in the key: XLA:CPU AOT results
+    # embed version-dependent target tuning (+prefer-no-gather/scatter et
+    # al.), so entries written by a different jaxlib merely *warn* about a
+    # machine-feature mismatch and then execute differently (observed: a
+    # carried-over cache flipped sampled tokens on this host)
+    try:
+        from importlib.metadata import version
+
+        ver = f"{version('jax')}-{version('jaxlib')}"
+    except Exception:
+        ver = "unknown"
     try:
         with open("/proc/cpuinfo") as f:
             content = f.read()
         for key in ("flags", "Features"):  # x86 / aarch64 spellings
             for line in content.splitlines():
                 if line.startswith(key):
-                    return hashlib.sha1(line.encode()).hexdigest()[:12]
+                    return hashlib.sha1(
+                        (ver + line).encode()
+                    ).hexdigest()[:12]
         # unknown layout: hash the whole thing (may over-rotate the cache on
         # per-boot fields, but never under-distinguishes vector extensions)
-        return hashlib.sha1(content.encode()).hexdigest()[:12]
+        return hashlib.sha1((ver + content).encode()).hexdigest()[:12]
     except OSError:
         import platform
 
-        key = f"{platform.machine()}-{platform.processor()}"
+        key = f"{ver}-{platform.machine()}-{platform.processor()}"
         return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 

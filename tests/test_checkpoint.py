@@ -50,7 +50,10 @@ def test_metric_history_survives_new_manager(tmp_path):
     cm = CheckpointManager(out, save_total_limit=2, greater_is_better=True)
     cm.save(1, params_like(1))
     cm.save(2, params_like(2), metric_old=9.0)    # best = step 1
-    # simulated restart
+    # simulated restart — flush the async writer first: a manager handed off
+    # without close()/wait() looks like a crash mid-save to the successor
+    # (uncommitted steps are clamped out of the metric history)
+    cm.close()
     cm2 = CheckpointManager(out, save_total_limit=2, greater_is_better=True)
     assert cm2.best_step() == 1
     cm2.save(3, params_like(3), metric_old=1.0)
@@ -70,3 +73,20 @@ def test_restore_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 42.0)
     assert cm.latest_step() == 7
     assert cm.load_trainer_state(7)["step"] == 7
+
+
+def test_uncommitted_checkpoint_ignored(tmp_path):
+    """A process that dies mid-async-save leaves a checkpoint dir without the
+    committed `tree/` subdir (orbax finalizes with an atomic rename). Such a
+    dir must be invisible to latest_step()/resume — restoring it would fail."""
+    out = str(tmp_path / "ck")
+    cm = CheckpointManager(out, save_total_limit=3)
+    cm.save(1, {"w": np.ones((2,))})
+    cm.close()
+    # simulate a crashed save: state json present, tree never committed
+    crashed = os.path.join(out, "checkpoint-9")
+    os.makedirs(crashed)
+    with open(os.path.join(crashed, "trainer_state.json"), "w") as f:
+        f.write('{"step": 9}')
+    cm2 = CheckpointManager(out, save_total_limit=3)
+    assert cm2.latest_step() == 1

@@ -71,12 +71,19 @@ def test_dense_sp2_matches_single_device(tmp_path):
         tmp_path, "sp2", make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2])
     )
     assert sp._sp_on() and not ctrl._sp_on()
-    s1 = ctrl.train()
-    s2 = sp.train()
-    assert s1["global_step"] == s2["global_step"] == 2
+    # compare ONE update only: update 1 trains on bit-identical rollouts
+    # (same PRNG stream + deterministic reward), so its metrics must agree.
+    # Anything after update 1 samples from post-update params, where ring
+    # attention's f32 reduction reorder shifts logits at bf16 scale and
+    # categorical sampling amplifies near-ties into different tokens —
+    # cross-parallelism trajectory equality is chaotic from update 2 on
+    # (observed: a host change alone flipped it).
+    s1 = ctrl.train(num_updates=1)
+    s2 = sp.train(num_updates=1)
+    assert s1["global_step"] == s2["global_step"] == 1
 
-    # same PRNG stream + deterministic reward -> identical rollouts; ring
-    # attention only reorders f32 reductions -> params agree to bf16 slack
+    # ring attention only reorders f32 reductions -> update-1 grads (and so
+    # params) agree to bf16 slack
     for a, b in zip(_lora_leaves(ctrl), _lora_leaves(sp)):
         np.testing.assert_allclose(
             a.astype(np.float32), b.astype(np.float32), rtol=5e-3, atol=2e-3
@@ -84,12 +91,17 @@ def test_dense_sp2_matches_single_device(tmp_path):
 
     m1 = _metric_rows(tmp_path / "ctrl")
     m2 = _metric_rows(tmp_path / "sp2")
-    assert len(m1) == len(m2) >= 1
+    assert len(m1) == len(m2) == 1
     for a, b in zip(m1, m2):
         assert abs(a["loss/policy_avg_new"] - b["loss/policy_avg_new"]) < 1e-3
         assert abs(a["objective/kl_old"] - b["objective/kl_old"]) < 1e-3
+        assert abs(a["eval_objective/scores_old"] - b["eval_objective/scores_old"]) < 1e-6
         # SP never materializes global logits: entropy stat reports 0.0
         assert b["policy/entropy_avg_new"] == 0.0
+
+    # a second sp update must still run and stay finite (no numeric claim)
+    sp.train(num_updates=1)
+    assert np.isfinite(_metric_rows(tmp_path / "sp2")[-1]["loss/policy_avg_new"])
 
 
 def test_dense_sp_reinforce_trains(tmp_path):

@@ -1,0 +1,135 @@
+"""Decode-lever ablation on real hardware — one process, one TPU claim.
+
+Measures rollout (generation) throughput of the flagship-shaped policy under
+each decode lever shipped in r2, at short and long response lengths. The
+levers (see docs/ROADMAP.md #2):
+
+  exact_topk    — lax.top_k nucleus (full-vocab sort on TPU; r1 behavior)
+  approx_topk   — lax.approx_max_k pre-trim (default since r2)
+  int8_weights  — rollout_quant="int8" weight-only base projections
+  int8_kv       — kv_cache_quant="int8" + q8 decode kernel
+  int8_both     — both quantizations
+  compact4      — rollout_compaction_segments=4 (continuous-batching analogue)
+
+Prints one JSON line per (lever, response_length) with decode tokens/s, and
+a final summary line. Run ON the axon env (the only jax process):
+
+  python tools/ablate_decode.py            # both lengths, all levers
+  ABLATE_RESPONSE=2048 python tools/ablate_decode.py
+  ABLATE_ROWS=32 ABLATE_LEVERS=approx_topk,int8_kv python tools/ablate_decode.py
+
+Timings are end-to-end generate() walls (device sync via np.asarray fetch) —
+per-op microbenches are unreliable over the tunnel; whole-loop walls are
+honest (memory: chained dispatch + full fetch).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.core.quant import quantize_layers, rollout_view
+    from nanorlhf_tpu.data import ToyTokenizer
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+
+    rows = int(os.environ.get("ABLATE_ROWS", 32))
+    lengths = (
+        [int(os.environ.get("ABLATE_RESPONSE"))]
+        if os.environ.get("ABLATE_RESPONSE")
+        else [256, 2048]
+    )
+    lever_env = os.environ.get("ABLATE_LEVERS")
+    model = os.environ.get("ABLATE_MODEL", "1_5b")
+
+    mcfg = (
+        ModelConfig.qwen2_1_5b() if model == "1_5b"
+        else ModelConfig.qwen2_tiny(vocab_size=4096)
+    )
+    tok = ToyTokenizer(vocab_size=min(4096, mcfg.vocab_size))
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    dev = jax.devices()[0]
+    print(f"[ablate] backend={jax.default_backend()} device={dev.device_kind}",
+          file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    Tp = 64
+    ids = rng.integers(4, tok.vocab_size, (rows, Tp)).astype(np.int32)
+    ids[:, :8] = tok.pad_token_id  # a little left-padding
+    ids_j = jnp.asarray(ids)
+    mask_j = ids_j != tok.pad_token_id
+
+    import dataclasses
+
+    def make_levers():
+        base = dict(params=params, mcfg=mcfg, sp_kw={}, note="")
+        q_params = None
+        kv_cfg = dataclasses.replace(mcfg, kv_cache_quant="int8")
+        levers = {
+            "exact_topk": dict(base, sp_kw={"approx_top_k": False}),
+            "approx_topk": dict(base),
+            "int8_weights": None,  # filled below (lazy quantize)
+            "int8_kv": dict(base, mcfg=kv_cfg),
+            "int8_both": None,
+            "compact4": dict(base, sp_kw={"compaction_segments": 4}),
+        }
+        wanted = (lever_env.split(",") if lever_env else list(levers))
+        if "int8_weights" in wanted or "int8_both" in wanted:
+            q_params = rollout_view(params, quantize_layers(params["layers"]))
+            levers["int8_weights"] = dict(base, params=q_params)
+            levers["int8_both"] = dict(base, params=q_params, mcfg=kv_cfg)
+        return {k: levers[k] for k in wanted if levers.get(k) is not None}
+
+    results = {}
+    for resp in lengths:
+        for name, spec in make_levers().items():
+            sp = SamplingParams(
+                temperature=0.9, top_p=0.95, max_tokens=resp,
+                **spec["sp_kw"],
+            )
+            # warmup (compile) + 2 timed reps
+            times = []
+            for rep in range(3):
+                t0 = time.time()
+                out = generate(spec["params"], spec["mcfg"], ids_j, mask_j,
+                               jax.random.PRNGKey(rep), sp,
+                               eos_token_id=tok.eos_token_id,
+                               pad_token_id=tok.pad_token_id)
+                np.asarray(out)  # full fetch = honest sync
+                times.append(time.time() - t0)
+            steady = float(np.mean(times[1:]))
+            toks = rows * resp / steady
+            results[(name, resp)] = toks
+            print(json.dumps({
+                "lever": name, "response_length": resp, "rows": rows,
+                "sec_steady": round(steady, 3), "compile_sec": round(times[0], 1),
+                "decode_tokens_per_sec": round(toks, 1),
+            }))
+
+    base_key = ("approx_topk", lengths[-1])
+    summary = {
+        "metric": "decode_ablation",
+        "device": dev.device_kind,
+        "best": max(results, key=results.get),
+        "tokens_per_sec": {f"{k[0]}@{k[1]}": round(v, 1)
+                           for k, v in results.items()},
+    }
+    if base_key in results:
+        summary["speedup_vs_approx_topk"] = {
+            f"{k[0]}@{k[1]}": round(v / results[base_key], 3)
+            for k, v in results.items() if k[1] == lengths[-1]
+        }
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
