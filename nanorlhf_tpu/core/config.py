@@ -25,6 +25,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = True
     max_position_embeddings: int = 32768
+    # Qwen2-family attention projections carry biases; Llama-family do not.
+    # The decoder treats biases as optional, so this only steers random init
+    # (HF loading is data-driven off the state dict).
+    attention_bias: bool = True
     # "int8": the sampler's KV cache stores int8 values + per-token-per-head
     # bf16 scales (absmax over head_dim). At long responses the cache read is
     # the dominant decode HBM stream (≈7.5 GB/step at 8k tokens, batch 32);
@@ -111,11 +115,50 @@ class ModelConfig:
         )
 
     @classmethod
+    def llama3_2_1b(cls) -> "ModelConfig":
+        """Llama-3.2-1B geometry — the Llama side of the same decoder
+        (no attention biases, untied-by-default in larger family members)."""
+        return cls(
+            vocab_size=128256,
+            hidden_size=2048,
+            intermediate_size=8192,
+            num_hidden_layers=16,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            head_dim=64,
+            rope_theta=500_000.0,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=True,
+            max_position_embeddings=131072,
+            attention_bias=False,
+        )
+
+    @classmethod
+    def llama3_8b(cls) -> "ModelConfig":
+        return cls(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_hidden_layers=32,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            rope_theta=500_000.0,
+            rms_norm_eps=1e-5,
+            tie_word_embeddings=False,
+            max_position_embeddings=131072,
+            attention_bias=False,
+        )
+
+    @classmethod
     def from_hf_config(cls, hf_config) -> "ModelConfig":
-        """Build from a `transformers` Qwen2Config (or dict)."""
+        """Build from a `transformers` Qwen2Config / LlamaConfig (or dict)."""
         get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
             hf_config, dict
         ) else (lambda k, d=None: hf_config.get(k, d))
+        # Qwen2 has no attention_bias knob (its q/k/v always carry biases);
+        # Llama-family configs expose it (default False)
+        model_type = str(get("model_type", "qwen2")).lower()
+        attn_bias = get("attention_bias", "qwen" in model_type)
         return cls(
             vocab_size=get("vocab_size"),
             hidden_size=get("hidden_size"),
@@ -128,4 +171,5 @@ class ModelConfig:
             rms_norm_eps=get("rms_norm_eps", 1e-6),
             tie_word_embeddings=get("tie_word_embeddings", False),
             max_position_embeddings=get("max_position_embeddings", 32768),
+            attention_bias=bool(attn_bias),
         )

@@ -52,16 +52,19 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict
     def stacked(k, shape, scale=None):
         return dense(k, (L,) + shape, scale)
 
+    def qkv(k, shape):
+        entry = {"kernel": stacked(k, shape)}
+        if config.attention_bias:  # Qwen2 yes, Llama no (core/config.py)
+            entry["bias"] = jnp.zeros((L, shape[-1]), dtype)
+        return entry
+
     params = {
         "embed_tokens": dense(next(keys), (V, D), scale=0.02),
         "layers": {
             "input_layernorm": jnp.ones((L, D), dtype),
-            "q_proj": {"kernel": stacked(next(keys), (D, H * hd)),
-                       "bias": jnp.zeros((L, H * hd), dtype)},
-            "k_proj": {"kernel": stacked(next(keys), (D, KV * hd)),
-                       "bias": jnp.zeros((L, KV * hd), dtype)},
-            "v_proj": {"kernel": stacked(next(keys), (D, KV * hd)),
-                       "bias": jnp.zeros((L, KV * hd), dtype)},
+            "q_proj": qkv(next(keys), (D, H * hd)),
+            "k_proj": qkv(next(keys), (D, KV * hd)),
+            "v_proj": qkv(next(keys), (D, KV * hd)),
             "o_proj": {"kernel": stacked(next(keys), (H * hd, D))},
             "post_attention_layernorm": jnp.ones((L, D), dtype),
             "gate_proj": {"kernel": stacked(next(keys), (D, F))},

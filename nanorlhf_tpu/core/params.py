@@ -1,13 +1,15 @@
 """HF checkpoint → stacked JAX param tree.
 
 The reference gets weights via `AutoModelForCausalLM.from_pretrained`
-(`/root/reference/GRPO/grpo.py:218-224`). Here we map the HF Qwen2 state-dict
-layout onto our scan-friendly stacked tree (core/model.py): per-layer tensors
-are stacked along a leading [L, ...] axis and torch `nn.Linear` weights
-([out, in]) are transposed to the x @ W layout ([in, out]).
+(`/root/reference/GRPO/grpo.py:218-224`). Here we map the HF Qwen2/Llama
+state-dict layout (both families share it — Llama just drops the q/k/v
+biases) onto our scan-friendly stacked tree (core/model.py): per-layer
+tensors are stacked along a leading [L, ...] axis and torch `nn.Linear`
+weights ([out, in]) are transposed to the x @ W layout ([in, out]).
 
 Weight fidelity (GQA head layout, tied embeddings, RoPE) is pinned by
-tests/test_model_parity.py against the torch Qwen2 implementation.
+tests/test_model_parity.py against the torch Qwen2 AND Llama
+implementations.
 """
 
 from __future__ import annotations
@@ -20,14 +22,16 @@ import numpy as np
 
 from nanorlhf_tpu.core.config import ModelConfig
 
+# bias presence is read off the state dict itself (Qwen2 q/k/v carry
+# biases, Llama-family none — both map onto the same optional-bias tree)
 _LINEAR_KEYS = (
-    ("q_proj", "self_attn.q_proj", True),
-    ("k_proj", "self_attn.k_proj", True),
-    ("v_proj", "self_attn.v_proj", True),
-    ("o_proj", "self_attn.o_proj", False),
-    ("gate_proj", "mlp.gate_proj", False),
-    ("up_proj", "mlp.up_proj", False),
-    ("down_proj", "mlp.down_proj", False),
+    ("q_proj", "self_attn.q_proj"),
+    ("k_proj", "self_attn.k_proj"),
+    ("v_proj", "self_attn.v_proj"),
+    ("o_proj", "self_attn.o_proj"),
+    ("gate_proj", "mlp.gate_proj"),
+    ("up_proj", "mlp.up_proj"),
+    ("down_proj", "mlp.down_proj"),
 )
 
 
@@ -61,12 +65,12 @@ def params_from_hf_state_dict(
             )
         ),
     }
-    for ours, theirs, has_bias in _LINEAR_KEYS:
+    for ours, theirs in _LINEAR_KEYS:
         kernel = np.stack(
             [sd[f"model.layers.{i}.{theirs}.weight"].T for i in range(L)]
         )
         entry = {"kernel": cast(kernel)}
-        if has_bias:
+        if f"model.layers.0.{theirs}.bias" in sd:
             entry["bias"] = cast(
                 np.stack([sd[f"model.layers.{i}.{theirs}.bias"] for i in range(L)])
             )

@@ -34,8 +34,20 @@ def resolve_model(sft_model_path: str, seed: int = 0, attention_impl: str = "aut
     else:
         print(f"[offline demo] '{sft_model_path}' not found locally — "
               "random-init model + toy tokenizer")
-        tiny = "tiny" in (sft_model_path or "")
-        config = ModelConfig.qwen2_tiny(vocab_size=4096) if tiny else ModelConfig.qwen2_1_5b()
+        path = (sft_model_path or "").lower()
+        llama = "llama" in path  # Llama-family geometry (no attention biases)
+        if "tiny" in path:
+            config = ModelConfig.qwen2_tiny(vocab_size=4096)
+            if llama:  # e.g. "TinyLlama-...": tiny shape, llama family
+                import dataclasses
+
+                config = dataclasses.replace(
+                    config, attention_bias=False, rope_theta=500_000.0
+                )
+        elif llama:
+            config = ModelConfig.llama3_2_1b()
+        else:
+            config = ModelConfig.qwen2_1_5b()
         tokenizer = ToyTokenizer(vocab_size=min(4096, config.vocab_size))
         params = init_params(config, jax.random.PRNGKey(seed), jnp.bfloat16)
     if attention_impl != config.attention_impl:

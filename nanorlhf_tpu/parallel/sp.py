@@ -169,6 +169,8 @@ def sp_score_logprobs(
     fsdp_axis: str | None = None,
     lora_scale: float = 1.0,
     remat: bool = False,
+    with_entropy: bool = False,
+    entropy_from_position: int = 0,
 ) -> jnp.ndarray:
     """Per-position next-token logprobs [B, T] under sequence parallelism —
     the scoring primitive for beyond-one-device contexts (the RL logprob
@@ -182,14 +184,27 @@ def sp_score_logprobs(
     params-sharded-at-rest variant. `remat` checkpoints per-layer activations
     — pass the trainer's gradient_checkpointing when differentiating through
     this (scoring-only callers can leave it off).
+
+    `with_entropy=True` additionally returns the unmasked-mean entropy of
+    the temperature-scaled logits (the reference's `policy/entropy_avg_new`
+    stat, `GRPO/grpo_trainer.py:679-687`): each shard's logits are
+    full-vocab, so per-position entropy is local and the global mean is one
+    psum over the sp axis — the global [B, T, V] logits never materialize.
+    The mean spans global positions [entropy_from_position, T-1) — callers
+    pass `context_length - 1` so the scope matches the dense path, whose
+    logits cover only the response region (`padded_forward_logits`'s
+    `response_context_length` slice); prompt positions have systematically
+    lower entropy on a trained model and must not dilute the stat.
     """
     from nanorlhf_tpu.core.model import padding_inputs
-    from nanorlhf_tpu.ops.masking import logprobs_from_logits
+    from nanorlhf_tpu.ops.masking import entropy_from_logits, logprobs_from_logits
 
     _, attention_mask, position_ids = padding_inputs(query_responses, pad_token_id)
     attention_mask = attention_mask.astype(jnp.int32)
 
     n_sp = mesh.shape[sp_axis]
+
+    T_global = query_responses.shape[1]
 
     def local_score(logits_local, ids_local):
         # label for local position t = ids[t+1]; last local label comes from
@@ -197,7 +212,24 @@ def sp_score_logprobs(
         perm = [(i, (i - 1) % n_sp) for i in range(n_sp)]
         from_right = jax.lax.ppermute(ids_local[:, :1], sp_axis, perm)
         labels = jnp.concatenate([ids_local[:, 1:], from_right], axis=1)
-        return logprobs_from_logits(logits_local, labels, temperature)
+        lp = logprobs_from_logits(logits_local, labels, temperature)
+        if not with_entropy:
+            return lp
+        # response-region scope: global positions [from, T-1) — same span
+        # the dense path's response_context_length slice covers
+        t_local = logits_local.shape[1]
+        gpos = jax.lax.axis_index(sp_axis) * t_local + jnp.arange(t_local)
+        in_span = (gpos >= entropy_from_position) & (gpos < T_global - 1)
+        ent_pos = jax.lax.stop_gradient(entropy_from_logits(
+            logits_local.astype(jnp.float32) / (temperature + 1e-7)
+        ))                                             # [B, T_local]
+        s = jax.lax.psum((ent_pos * in_span[None, :]).sum(), sp_axis)
+        c = jax.lax.psum(
+            (in_span.sum() * ent_pos.shape[0]).astype(jnp.float32), sp_axis
+        )
+        return lp, s / jnp.maximum(c, 1.0)
+
+    out_specs = (P(None, sp_axis), P()) if with_entropy else P(None, sp_axis)
 
     if fsdp_axis is not None:
         specs = _fsdp_specs(params, fsdp_axis)
@@ -209,10 +241,10 @@ def sp_score_logprobs(
             )
             return local_score(logits, ids)
 
-        lp = shard_map(
+        out = shard_map(
             fn, mesh=mesh,
             in_specs=(specs, P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
-            out_specs=P(None, sp_axis),
+            out_specs=out_specs,
             check_vma=False,
         )(params, query_responses, attention_mask, position_ids)
     else:
@@ -223,13 +255,16 @@ def sp_score_logprobs(
             )
             return local_score(logits, ids)
 
-        lp = shard_map(
+        out = shard_map(
             fn, mesh=mesh,
             in_specs=(P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
-            out_specs=P(None, sp_axis),
+            out_specs=out_specs,
+            check_vma=False,
         )(query_responses, attention_mask, position_ids)
+    lp, ent = out if with_entropy else (out, None)
     # final global position has no next token
-    return lp.at[:, -1].set(0.0)
+    lp = lp.at[:, -1].set(0.0)
+    return (lp, ent) if with_entropy else lp
 
 
 def sp_fsdp_forward_logits(

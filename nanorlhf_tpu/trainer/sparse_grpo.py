@@ -198,18 +198,22 @@ class SparseGRPOTrainer(RLTrainer):
 
         def loss_fn(trainable, frozen, mb, context_length, loss_scale):
             tree = combine(trainable, frozen)
-            new_lp = sp_score_logprobs(
+            new_lp, entropy = sp_score_logprobs(
                 tree["policy"], mcfg, mb["query_responses"], pad_id,
                 cfg.temperature, mesh, fsdp_axis=fsdp_axis,
                 lora_scale=lora_scale, remat=cfg.gradient_checkpointing,
-            )[:, context_length - 1 : -1]
+                with_entropy=True, entropy_from_position=context_length - 1,
+            )
+            new_lp = new_lp[:, context_length - 1 : -1]
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
             loss, aux = grpo_loss(
                 new_lp, mb["logprobs"], mb["ref_logprobs"], mb["advantages"],
                 ~mb["padding_mask"], cfg.cliprange, cfg.kl_coef,
             )
-            # no entropy stat: the global [B, T, V] logits never materialize
-            # under SP (that's the point) — metrics fall back to 0.0
+            # the global [B, T, V] logits never materialize under SP (that's
+            # the point) — the entropy stat is a per-shard mean pmean'd over
+            # the ring inside the scorer
+            aux["entropy"] = entropy
             return loss * loss_scale, aux
 
         @partial(jax.jit, static_argnums=(3,))

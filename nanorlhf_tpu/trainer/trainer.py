@@ -421,14 +421,15 @@ class RLTrainer:
                 from nanorlhf_tpu.parallel.sp import sp_score_logprobs
 
                 # ring-attention sequence-parallel forward; the global
-                # [B, T, V] logits never materialize, so the entropy stat
-                # is unavailable (0.0) on this path — same as SparseGRPO's
-                new_logprobs = sp_score_logprobs(
+                # [B, T, V] logits never materialize — the entropy stat
+                # comes back as a per-shard mean pmean'd over the ring
+                new_logprobs, entropy = sp_score_logprobs(
                     train_tree["policy"], mcfg, mb["query_responses"], pad_id,
                     cfg.temperature, sp_mesh, fsdp_axis=sp_fsdp_axis,
-                    lora_scale=lora_scale, remat=remat,
-                )[:, context_length - 1 : -1]
-                entropy = jnp.float32(0.0)
+                    lora_scale=lora_scale, remat=remat, with_entropy=True,
+                    entropy_from_position=context_length - 1,
+                )
+                new_logprobs = new_logprobs[:, context_length - 1 : -1]
             else:
                 logits = padded_forward_logits(
                     train_tree["policy"], mcfg, mb["query_responses"], pad_id,

@@ -68,15 +68,50 @@ _cache_dir = os.path.abspath(os.path.join(
 
 # Crash healing: a suite process that dies hard (SIGKILL mid-write, native
 # abort) can leave a corrupt cache entry that SIGABRTs every later run at
-# load time (observed). A sentinel marks a suite in progress; finding one at
-# startup means the previous run died mid-suite — wipe the cache and recompile
-# rather than abort forever.
-_sentinel = os.path.join(_cache_dir, ".suite_in_progress")
-if os.path.exists(_sentinel):
+# load time (observed). Sentinels mark suites in progress — but they must be
+# PID-AWARE: the naive "sentinel exists → previous run crashed → wipe"
+# logic wiped the cache out from under a CONCURRENT suite when two pytest
+# processes overlapped (observed: the live run then died on torn cache
+# state, which planted the next crash sentinel — a self-sustaining failure).
+# Rules: a sentinel whose pid is dead marks a crash; wipe only when a crash
+# marker exists AND no live suite holds the cache.
+os.makedirs(_cache_dir, exist_ok=True)
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        # a corrupt/empty sentinel parses to -1; os.kill(-1, 0) signals the
+        # whole process group and SUCCEEDS — treat nonpositive pids as dead
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+import glob
+
+_saw_crash, _saw_live = False, False
+for _f in glob.glob(os.path.join(_cache_dir, ".suite_in_progress*")):
+    try:
+        _pid = int(open(_f).read().strip() or -1)
+    except (OSError, ValueError):
+        _pid = -1
+    if _pid_alive(_pid):
+        _saw_live = True
+    else:
+        _saw_crash = True
+        try:
+            os.remove(_f)
+        except OSError:
+            pass
+if _saw_crash and not _saw_live:
     import shutil
 
     shutil.rmtree(_cache_dir, ignore_errors=True)
-os.makedirs(_cache_dir, exist_ok=True)
+    os.makedirs(_cache_dir, exist_ok=True)
+_sentinel = os.path.join(_cache_dir, f".suite_in_progress.{os.getpid()}")
 with open(_sentinel, "w") as _f:
     _f.write(str(os.getpid()))
 

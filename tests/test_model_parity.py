@@ -125,3 +125,72 @@ def test_untied_lm_head(rng):
         model_forward(params, config, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos))
     )
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_pair():
+    """Llama-family variant of the parity gate: no q/k/v biases, llama
+    RoPE/theta — same decoder, attention_bias=False (core/config.py)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_config = LlamaConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=1024,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(1)
+    model = LlamaForCausalLM(hf_config).eval().to(torch.float32)
+    config = ModelConfig.from_hf_config(hf_config)
+    assert not config.attention_bias
+    params = params_from_hf_state_dict(config, model.state_dict(), dtype=jnp.float32)
+    assert "bias" not in params["layers"]["q_proj"]
+    return model, config, params
+
+
+def test_llama_logit_parity(tiny_llama_pair, rng):
+    model, config, params = tiny_llama_pair
+    B, T = 3, 12
+    ids = rng.integers(2, 512, size=(B, T))
+    mask = np.ones((B, T), dtype=np.int64)
+    pos = np.cumsum(mask, axis=1) - 1
+    with torch.no_grad():
+        want = model(
+            input_ids=torch.from_numpy(ids),
+            attention_mask=torch.from_numpy(mask),
+            position_ids=torch.from_numpy(pos),
+        ).logits.numpy()
+    got = np.asarray(
+        model_forward(params, config, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos))
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_init_params_no_bias():
+    """Random init honors attention_bias=False; forward + greedy decode run."""
+    from nanorlhf_tpu.core import init_params
+    from nanorlhf_tpu.data import ToyTokenizer
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        ModelConfig.qwen2_tiny(vocab_size=128), attention_bias=False,
+        rope_theta=500000.0,
+    )
+    params = init_params(cfg, __import__("jax").random.PRNGKey(0), jnp.float32)
+    assert "bias" not in params["layers"]["k_proj"]
+    tok = ToyTokenizer(vocab_size=128)
+    import jax
+    ids = jnp.asarray(np.full((2, 4), 7, np.int32))
+    out = generate(params, cfg, ids, ids != 0, jax.random.PRNGKey(0),
+                   SamplingParams(greedy=True, max_tokens=6),
+                   eos_token_id=3, pad_token_id=0)
+    assert out.shape == (2, 6)

@@ -94,3 +94,34 @@ def test_dcn_mesh_axis():
     np.testing.assert_allclose(np.asarray(y), 16.0)
     with pytest.raises(ValueError):
         MeshConfig(data=3, dcn_data=2).resolve(3)
+
+
+def test_rules_shard_large_geometries_evenly():
+    """TP/FSDP claims hold at real scale: every sharded dim of the 7B/8B
+    trees divides by its mesh axis on a (1,2,2) mesh. eval_shape only —
+    no 7B allocation."""
+    from nanorlhf_tpu.core import init_params
+
+    mesh = make_mesh(MeshConfig(1, 2, 2, 1), devices=jax.devices()[:4])
+    for cfg in (ModelConfig.qwen2_7b(), ModelConfig.llama3_8b(),
+                ModelConfig.qwen2_0_5b()):
+        shapes = jax.eval_shape(
+            lambda k, c=cfg: init_params(c, k, jnp.bfloat16),
+            jax.random.PRNGKey(0),
+        )
+        rules = param_sharding_rules(shapes)
+        leaves = jax.tree_util.tree_leaves_with_path(shapes)
+        specs = jax.tree_util.tree_leaves_with_path(rules)
+        assert len(leaves) == len(specs)
+        for (path, leaf), (_, spec) in zip(leaves, specs):
+            for dim, axes in enumerate(spec):
+                if axes is None:
+                    continue
+                axes = axes if isinstance(axes, tuple) else (axes,)
+                n = 1
+                for a in axes:
+                    n *= mesh.shape[a]
+                assert leaf.shape[dim] % n == 0, (
+                    f"{cfg.hidden_size=} {path} dim {dim} "
+                    f"({leaf.shape[dim]}) not divisible by {axes} ({n})"
+                )

@@ -96,8 +96,9 @@ def test_dense_sp2_matches_single_device(tmp_path):
         assert abs(a["loss/policy_avg_new"] - b["loss/policy_avg_new"]) < 1e-3
         assert abs(a["objective/kl_old"] - b["objective/kl_old"]) < 1e-3
         assert abs(a["eval_objective/scores_old"] - b["eval_objective/scores_old"]) < 1e-6
-        # SP never materializes global logits: entropy stat reports 0.0
-        assert b["policy/entropy_avg_new"] == 0.0
+        # SP never materializes global logits — the entropy stat is a
+        # per-shard mean pmean'd over the ring, and must match single-device
+        assert abs(a["policy/entropy_avg_new"] - b["policy/entropy_avg_new"]) < 1e-3
 
     # a second sp update must still run and stay finite (no numeric claim)
     sp.train(num_updates=1)
