@@ -87,6 +87,116 @@ def params_from_hf_state_dict(
     return params
 
 
+def hf_state_dict_from_params(config: ModelConfig, params: dict,
+                              dtype=jnp.float32) -> dict:
+    """Inverse of `params_from_hf_state_dict`: stacked JAX tree → flat HF
+    Qwen2/Llama state dict (torch [out, in] linear layout), cast per-tensor
+    to `dtype` so a 7B export never holds a second full-precision copy.
+    LoRA subtrees are NOT folded here — pass a `merge_lora`'d tree to export
+    adapters into the base weights (`save_model` parity: the reference's
+    trained output is a plain HF checkpoint, `GRPO/grpo_trainer.py:321-341`)."""
+    L = config.num_hidden_layers
+    sd: dict = {}
+
+    def put(name, arr):
+        sd[name] = jnp.asarray(arr, dtype)
+
+    layers = params["layers"]
+    for i in range(L):
+        put(f"model.layers.{i}.input_layernorm.weight",
+            layers["input_layernorm"][i])
+        put(f"model.layers.{i}.post_attention_layernorm.weight",
+            layers["post_attention_layernorm"][i])
+        for ours, theirs in _LINEAR_KEYS:
+            put(f"model.layers.{i}.{theirs}.weight", layers[ours]["kernel"][i].T)
+            if "bias" in layers[ours]:
+                put(f"model.layers.{i}.{theirs}.bias", layers[ours]["bias"][i])
+    put("model.embed_tokens.weight", params["embed_tokens"])
+    put("model.norm.weight", params["norm"])
+    if not config.tie_word_embeddings:
+        put("lm_head.weight", params["lm_head"].T)
+    return sd
+
+
+def export_hf_checkpoint(
+    config: ModelConfig,
+    params: dict,
+    out_dir: str,
+    lora_scale: float | None = None,
+    dtype: str = "bfloat16",
+    tokenizer=None,
+    eos_token_id: int | None = None,
+    bos_token_id: int | None = None,
+    pad_token_id: int | None = None,
+) -> str:
+    """Write an HF-format checkpoint dir (config.json + model.safetensors)
+    that `AutoModelForCausalLM.from_pretrained` (and this module's
+    `load_hf_checkpoint`) accepts — the reference's `save_model` output
+    contract. `lora_scale` folds a `params["lora"]` subtree into the base
+    weights first (the reference merges adapters before saving/handoff,
+    `GRPO/grpo_trainer.py:131-141,321-341`).
+
+    The handoff is only usable if generation knows how to stop and tokenize:
+    a `tokenizer` with `save_pretrained` is saved alongside the weights
+    (the reference's save_model does the same), and eos/bos/pad ids — taken
+    from the tokenizer when not given — go into config.json and
+    generation_config.json so transformers/vLLM terminate correctly."""
+    from safetensors.flax import save_file
+
+    if lora_scale is not None and "lora" in params:
+        from nanorlhf_tpu.core.lora import merge_lora
+
+        params = merge_lora(params, lora_scale)
+    params = {k: v for k, v in params.items() if k != "lora"}
+
+    os.makedirs(out_dir, exist_ok=True)
+    jdtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype]
+    sd = hf_state_dict_from_params(config, params, dtype=jdtype)
+    save_file(sd, os.path.join(out_dir, "model.safetensors"))
+
+    if tokenizer is not None:
+        if eos_token_id is None:
+            eos_token_id = getattr(tokenizer, "eos_token_id", None)
+        if bos_token_id is None:
+            bos_token_id = getattr(tokenizer, "bos_token_id", None)
+        if pad_token_id is None:
+            pad_token_id = getattr(tokenizer, "pad_token_id", None)
+        if hasattr(tokenizer, "save_pretrained"):
+            tokenizer.save_pretrained(out_dir)
+
+    is_llama = not config.attention_bias
+    hf_config = {
+        "architectures": ["LlamaForCausalLM" if is_llama else "Qwen2ForCausalLM"],
+        "model_type": "llama" if is_llama else "qwen2",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.actual_head_dim,
+        "rope_theta": config.rope_theta,
+        "rms_norm_eps": config.rms_norm_eps,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "max_position_embeddings": config.max_position_embeddings,
+        "attention_bias": config.attention_bias,
+        "hidden_act": "silu",
+        "torch_dtype": dtype,
+    }
+    gen_config = {"_from_model_config": True}
+    for key, val in (("eos_token_id", eos_token_id),
+                     ("bos_token_id", bos_token_id),
+                     ("pad_token_id", pad_token_id)):
+        if val is not None:
+            hf_config[key] = int(val)
+            gen_config[key] = int(val)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_config, f, indent=2)
+    with open(os.path.join(out_dir, "generation_config.json"), "w") as f:
+        json.dump(gen_config, f, indent=2)
+    return out_dir
+
+
 def load_hf_checkpoint(model_dir: str, dtype=jnp.bfloat16):
     """Load (ModelConfig, params) from an HF model directory on disk.
 

@@ -157,6 +157,9 @@ class RLConfig:
     metric_for_best_model: str = "eval_objective/rlhf_reward_old"
     greater_is_better: bool = True
     load_best_model_at_end: bool = True
+    # after the full run (and load_best), also write an HF-format checkpoint
+    # (LoRA merged) here — the reference's `save_model` handoff artifact
+    export_hf_dir: Optional[str] = None
     eval_steps: int = 1
     logging_steps: int = 1
     num_printed_samples: int = 5         # rich-table rows (`GRPO/grpo_trainer.py:717`)

@@ -991,6 +991,11 @@ class RLTrainer:
                 if self._quant_layers is not None:
                     self._refresh_quant_layers()
                 print(f"loaded best checkpoint (step {best})")
+        if cfg.export_hf_dir and num_updates is None:
+            # handoff artifact AFTER load_best: the exported policy is the
+            # one the run would deploy
+            print(f"exporting HF checkpoint to {cfg.export_hf_dir}")
+            self.export_model(cfg.export_hf_dir)
         return self.state
 
     def _restore_template(self):
@@ -1053,6 +1058,19 @@ class RLTrainer:
         for _ in range(self.state["rollouts"]):
             next(self._iter)
         return self.state
+
+    def export_model(self, out_dir: str, dtype: str = "bfloat16") -> str:
+        """Write the CURRENT policy as an HF-format checkpoint (config.json
+        + model.safetensors), LoRA folded into the base weights — the
+        reference's `save_model` output contract (`grpo_trainer.py:321-341`):
+        what comes out of training is a directory transformers/vLLM load."""
+        from nanorlhf_tpu.core.params import export_hf_checkpoint
+
+        return export_hf_checkpoint(
+            self.mcfg, self.params, out_dir,
+            lora_scale=self.lora_scale if self.cfg.use_lora else None,
+            dtype=dtype, tokenizer=self.tokenizer,
+        )
 
     def close(self):
         self.ckpt.close()  # flush any in-flight async checkpoint write

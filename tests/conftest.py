@@ -86,7 +86,9 @@ def _pid_alive(pid: int) -> bool:
     try:
         os.kill(pid, 0)
         return True
-    except (OSError, ValueError):
+    except PermissionError:
+        return True  # alive, owned by another user — must NOT wipe under it
+    except (ProcessLookupError, ValueError, OSError):
         return False
 
 

@@ -1,0 +1,149 @@
+"""HF-format checkpoint export (core/params.export_hf_checkpoint):
+`save_model` parity — the trained output is a checkpoint transformers (and
+our own loader) accept, with LoRA folded in (`GRPO/grpo_trainer.py:321-341`).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params, padded_forward_logits
+from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params, merge_lora
+from nanorlhf_tpu.core.params import export_hf_checkpoint, load_hf_checkpoint
+
+
+def _tiny(bias=True):
+    cfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    return cfg if bias else dataclasses.replace(
+        cfg, attention_bias=False, rope_theta=500000.0
+    )
+
+
+def test_roundtrip_with_lora_merge(tmp_path):
+    cfg = _tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    params["lora"] = init_lora_params(
+        cfg, LoraConfig(r=4), jax.random.PRNGKey(1), jnp.float32
+    )
+    # make B nonzero so the merge actually changes weights
+    params["lora"] = jax.tree.map(
+        lambda x: x + 0.01, params["lora"]
+    )
+    out = export_hf_checkpoint(cfg, params, str(tmp_path / "ck"),
+                               lora_scale=2.0, dtype="float32")
+    cfg2, params2 = load_hf_checkpoint(out, dtype=jnp.float32)
+    assert cfg2.attention_bias and cfg2.vocab_size == 256
+
+    ids = jnp.asarray(np.random.default_rng(0).integers(2, 256, (2, 8)),
+                      jnp.int32)
+    want = padded_forward_logits(merge_lora(params, 2.0), cfg, ids, 0)
+    got = padded_forward_logits(params2, cfg2, ids, 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("bias", [True, False])
+def test_transformers_loads_export(tmp_path, bias):
+    """The exported dir must load through transformers AND score identically
+    — the actual handoff contract (HF/vLLM users of the trained model)."""
+    from transformers import AutoModelForCausalLM
+
+    cfg = _tiny(bias)
+    params = init_params(cfg, jax.random.PRNGKey(2), jnp.float32)
+    out = export_hf_checkpoint(cfg, params, str(tmp_path / "ck"),
+                               dtype="float32")
+    model = AutoModelForCausalLM.from_pretrained(out).eval().to(torch.float32)
+    assert model.config.model_type == ("qwen2" if bias else "llama")
+
+    ids = np.random.default_rng(1).integers(2, 256, (2, 10))
+    mask = np.ones_like(ids)
+    pos = np.cumsum(mask, axis=1) - 1
+    with torch.no_grad():
+        want = model(
+            input_ids=torch.from_numpy(ids),
+            attention_mask=torch.from_numpy(mask),
+            position_ids=torch.from_numpy(pos),
+        ).logits.numpy()
+    from nanorlhf_tpu.core import model_forward
+
+    got = np.asarray(model_forward(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(pos)
+    ))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_export_loads(tmp_path):
+    cfg = _tiny()
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.bfloat16)
+    out = export_hf_checkpoint(cfg, params, str(tmp_path / "ck"))
+    cfg2, params2 = load_hf_checkpoint(out)
+    leaf = params2["layers"]["q_proj"]["kernel"]
+    assert leaf.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(leaf, np.float32),
+        np.asarray(params["layers"]["q_proj"]["kernel"], np.float32),
+    )
+
+
+def test_trainer_export_model(tmp_path):
+    """RLTrainer.export_model: train a step, export, reload, score parity
+    with the live (merged) policy."""
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_trainer_smoke import make_trainer
+    from nanorlhf_tpu.trainer import AlgoName
+
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=16, save_steps=0)
+    tr.train(num_updates=1)
+    out = tr.export_model(str(tmp_path / "hf"), dtype="float32")
+    cfg2, params2 = load_hf_checkpoint(out, dtype=jnp.float32)
+
+    ids = jnp.asarray(np.random.default_rng(2).integers(
+        2, tr.mcfg.vocab_size, (2, 8)), jnp.int32)
+    want = padded_forward_logits(
+        merge_lora(tr.params, tr.lora_scale), tr.mcfg, ids, 0
+    )
+    got = padded_forward_logits(params2, cfg2, ids, 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_export_hf_dir_config(tmp_path):
+    """export_hf_dir: the full run leaves an HF checkpoint behind."""
+    import os
+    import sys
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_trainer_smoke import make_trainer
+    from nanorlhf_tpu.trainer import AlgoName
+
+    hf_dir = str(tmp_path / "handoff")
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=16,
+                      save_steps=0, export_hf_dir=hf_dir)
+    tr.train()
+    assert os.path.exists(os.path.join(hf_dir, "model.safetensors"))
+    cfg2, _ = load_hf_checkpoint(hf_dir)
+    assert cfg2.vocab_size == tr.mcfg.vocab_size
+
+
+def test_export_writes_generation_config(tmp_path):
+    """eos/pad ids from the tokenizer reach config.json +
+    generation_config.json — without them, transformers/vLLM generation on
+    the exported dir never terminates."""
+    import json as _json
+
+    from nanorlhf_tpu.data import ToyTokenizer
+
+    cfg = _tiny()
+    tok = ToyTokenizer(vocab_size=256)
+    params = init_params(cfg, jax.random.PRNGKey(4), jnp.float32)
+    out = export_hf_checkpoint(cfg, params, str(tmp_path / "ck"),
+                               dtype="float32", tokenizer=tok)
+    gen = _json.load(open(out + "/generation_config.json"))
+    hfc = _json.load(open(out + "/config.json"))
+    assert gen["eos_token_id"] == tok.eos_token_id == hfc["eos_token_id"]
+    assert gen["pad_token_id"] == tok.pad_token_id
