@@ -29,18 +29,35 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from nanorlhf_tpu.core.config import ModelConfig
-from nanorlhf_tpu.core.model import _hidden_from_inputs, _logits
-from nanorlhf_tpu.parallel.ring_attention import ring_attention
+from nanorlhf_tpu.core.model import _hidden_from_inputs, _logits, use_flash
+from nanorlhf_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_flash,
+)
+
+
+def _ring_attn_fn(key_valid, axis_name, attn_impl: str, t_local: int):
+    """Pick the ring implementation: the Pallas flash ring (forward-only,
+    `ring_attention_flash`) when `attn_impl` resolves to flash at this local
+    width, the differentiable einsum ring otherwise. Callers that
+    differentiate MUST stay on "xla"."""
+    if use_flash(attn_impl, t_local):
+        return lambda q, k, v: ring_attention_flash(
+            q, k, v, key_valid, axis_name=axis_name, causal=True
+        )
+    return lambda q, k, v: ring_attention(
+        q, k, v, key_valid, axis_name=axis_name, causal=True
+    )
 
 
 def _sp_forward_local(params, config: ModelConfig, input_ids, attention_mask,
-                      position_ids, axis_name, lora_scale, remat):
+                      position_ids, axis_name, lora_scale, remat,
+                      attn_impl: str = "xla"):
     """Runs inside shard_map: the shared forward recipe with the attention
     contraction routed around the ring (no duplicated embed/scan logic)."""
     key_valid = attention_mask.astype(bool)
-
-    def ring_attn(q, k, v):
-        return ring_attention(q, k, v, key_valid, axis_name=axis_name, causal=True)
+    ring_attn = _ring_attn_fn(key_valid, axis_name, attn_impl,
+                              input_ids.shape[1])
 
     x = _hidden_from_inputs(
         params, config, jnp.where(key_valid, input_ids, 0), attention_mask,
@@ -115,7 +132,8 @@ def _gather_by_spec(tree, specs, axis_name: str, skip_leading_dim: bool = False)
 
 
 def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
-                           params_local, input_ids, attention_mask, position_ids):
+                           params_local, input_ids, attention_mask, position_ids,
+                           attn_impl: str = "xla"):
     """Inside shard_map over (fsdp, sp): sequence shard local, params shards
     gathered — embeddings up front (the lookup needs them), layer leaves one
     scan step at a time via the shared recipe's `layer_transform` hook, the
@@ -123,9 +141,8 @@ def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
     back through all_gather's transpose (reduce-scatter), so grads come out
     sharded exactly like the params."""
     key_valid = attention_mask.astype(bool)
-
-    def ring_attn(q, k, v):
-        return ring_attention(q, k, v, key_valid, axis_name=sp_axis, causal=True)
+    ring_attn = _ring_attn_fn(key_valid, sp_axis, attn_impl,
+                              input_ids.shape[1])
 
     lora_specs = specs.get("lora", {}).get("layers")
 
@@ -171,6 +188,7 @@ def sp_score_logprobs(
     remat: bool = False,
     with_entropy: bool = False,
     entropy_from_position: int = 0,
+    attn_impl: str = "xla",
 ) -> jnp.ndarray:
     """Per-position next-token logprobs [B, T] under sequence parallelism —
     the scoring primitive for beyond-one-device contexts (the RL logprob
@@ -184,6 +202,11 @@ def sp_score_logprobs(
     params-sharded-at-rest variant. `remat` checkpoints per-layer activations
     — pass the trainer's gradient_checkpointing when differentiating through
     this (scoring-only callers can leave it off).
+
+    `attn_impl` routes the ring: "auto"/"pallas" engage the forward-only
+    flash ring (`ring_attention_flash`) per `use_flash` resolution —
+    SCORING-ONLY; callers that differentiate (the update path) must keep
+    the default "xla" einsum ring, which has a backward.
 
     `with_entropy=True` additionally returns the unmasked-mean entropy of
     the temperature-scaled logits (the reference's `policy/entropy_avg_new`
@@ -237,7 +260,7 @@ def sp_score_logprobs(
         def fn(params_local, ids, mask, pos):
             logits = _sp_fsdp_forward_local(
                 config, specs, sp_axis, fsdp_axis, lora_scale, remat,
-                params_local, ids, mask, pos,
+                params_local, ids, mask, pos, attn_impl=attn_impl,
             )
             return local_score(logits, ids)
 
@@ -252,6 +275,7 @@ def sp_score_logprobs(
             logits = _sp_forward_local(
                 params, config, ids, mask, pos,
                 axis_name=sp_axis, lora_scale=lora_scale, remat=remat,
+                attn_impl=attn_impl,
             )
             return local_score(logits, ids)
 

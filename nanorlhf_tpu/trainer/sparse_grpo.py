@@ -172,13 +172,15 @@ class SparseGRPOTrainer(RLTrainer):
 
         @partial(jax.jit, static_argnums=(3,))
         def score(params, ref_params, qr, context_length: int):
+            # scoring never differentiates → the flash ring is legal
             lp = sp_score_logprobs(
                 params, mcfg, qr, pad_id, cfg.temperature, mesh,
                 fsdp_axis=fsdp_axis, lora_scale=lora_scale,
+                attn_impl=mcfg.attention_impl,
             )[:, context_length - 1 : -1]
             rlp = sp_score_logprobs(
                 ref_params, mcfg, qr, pad_id, cfg.temperature, mesh,
-                fsdp_axis=fsdp_axis,
+                fsdp_axis=fsdp_axis, attn_impl=mcfg.attention_impl,
             )[:, context_length - 1 : -1]
             return lp, rlp
 

@@ -588,13 +588,15 @@ class RLTrainer:
 
             @partial(jax.jit, static_argnums=(3,))
             def score(params, ref_params, query_responses, context_length: int):
+                # scoring never differentiates → the flash ring is legal
                 lp = sp_score_logprobs(
                     params, mcfg, query_responses, pad_id, cfg.temperature,
                     mesh, fsdp_axis=fsdp_axis, lora_scale=lora_scale,
+                    attn_impl=mcfg.attention_impl,
                 )[:, context_length - 1 : -1]
                 rlp = sp_score_logprobs(
                     ref_params, mcfg, query_responses, pad_id, cfg.temperature,
-                    mesh, fsdp_axis=fsdp_axis,
+                    mesh, fsdp_axis=fsdp_axis, attn_impl=mcfg.attention_impl,
                 )[:, context_length - 1 : -1]
                 return lp, rlp
 
@@ -638,7 +640,7 @@ class RLTrainer:
             def score_ref(ref_params, query_responses, context_length: int):
                 return sp_score_logprobs(
                     ref_params, mcfg, query_responses, pad_id, cfg.temperature,
-                    mesh, fsdp_axis=fsdp_axis,
+                    mesh, fsdp_axis=fsdp_axis, attn_impl=mcfg.attention_impl,
                 )[:, context_length - 1 : -1]
 
             self._ref_score_cached = score_ref

@@ -58,3 +58,19 @@ def test_sp_score_response_slice_semantics(rng):
         qr[:, ctx:], 1.0,
     ))
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_sp_score_flash_ring_matches(rng):
+    """attn_impl="pallas" routes the scorer through the forward-only flash
+    ring (interpret mode here) — logprobs must match the xla einsum ring."""
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    ids = rng.integers(2, 128, size=(2, 64)).astype(np.int32)
+    ids[0, :6] = 0  # left padding
+    qr = jnp.asarray(ids)
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
+    got = np.asarray(sp_score_logprobs(params, config, qr, 0, 0.9, mesh,
+                                       attn_impl="pallas"))
+    want = np.asarray(sp_score_logprobs(params, config, qr, 0, 0.9, mesh))
+    real = np.asarray(qr != 0)
+    np.testing.assert_allclose(got * real, want * real, rtol=2e-4, atol=2e-4)
