@@ -11,6 +11,8 @@ path still runs.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -58,6 +60,11 @@ def build_config(sequence_parallel: int = 1,
         eval_steps=10,                            # accuracy every 10 steps
         save_steps=1,
         save_total_limit=8,
+        # re-launches mmap the tokenized corpus instead of re-tokenizing
+        # 250k prompts (data/token_cache.py)
+        dataset_cache_dir="output/grpo-r1-v0/token_cache",
+        # the run's deploy artifact: HF checkpoint, LoRA merged
+        export_hf_dir="output/grpo-r1-v0/hf_export",
     )
     cfg.rollout_ahead = rollout_ahead
     if sequence_parallel > 1:
@@ -108,9 +115,34 @@ def load_math_datasets(train_name: str, eval_name: str, limit: int | None = None
         return synthetic_math_corpus(512), synthetic_math_corpus(64, seed=1)
 
 
-def build_prompt_dataset(train_qa, tokenizer, max_prompt_len: int = 512):
-    texts = [TEMPLATE.replace("QUESTION", q) for q, _ in train_qa]
-    ids = [tokenizer.encode(t)[:max_prompt_len] for t in texts]
+def build_prompt_dataset(train_qa, tokenizer, max_prompt_len: int = 512,
+                         cache_dir: str | None = None):
+    """Templated + tokenized prompt dataset. `cache_dir` enables the mmap
+    token cache (data/token_cache.py) keyed on the corpus content hash —
+    relaunches skip tokenizing the 250k-question corpus."""
+    ids = None
+    cache_path = fp = None
+    if cache_dir is not None:
+        import hashlib
+
+        from nanorlhf_tpu.data.token_cache import (
+            corpus_fingerprint, load_token_cache, save_token_cache,
+            tokenizer_identity)
+
+        corpus_h = hashlib.blake2b(
+            "\x1e".join(q for q, _ in train_qa).encode(), digest_size=8
+        ).hexdigest()
+        fp = corpus_fingerprint(
+            corpus=corpus_h, template=TEMPLATE, max_prompt_len=max_prompt_len,
+            tok=tokenizer_identity(tokenizer),
+        )
+        cache_path = os.path.join(cache_dir, f"prompts-{fp:016x}.tok")
+        ids = load_token_cache(cache_path, fp)
+    if ids is None:
+        texts = [TEMPLATE.replace("QUESTION", q) for q, _ in train_qa]
+        ids = [tokenizer.encode(t)[:max_prompt_len] for t in texts]
+        if cache_path is not None:
+            save_token_cache(cache_path, ids, fp)
     return PromptDataset(_left_pad(ids, tokenizer.pad_token_id), tokenizer.pad_token_id)
 
 
@@ -183,7 +215,8 @@ def main(cfg: RLConfig | None = None, limit: int | None = None):
     train_qa, eval_qa = load_math_datasets("meta-math/MetaMathQA", "HuggingFaceH4/MATH-500",
                                            limit=limit)
     train_index = dict(train_qa)
-    dataset = build_prompt_dataset(train_qa, tokenizer)
+    dataset = build_prompt_dataset(train_qa, tokenizer,
+                                   cache_dir=cfg.dataset_cache_dir)
     trainer = SparseGRPOTrainer(
         cfg, mcfg, tokenizer, params, dataset,
         make_r1_reward(train_index),

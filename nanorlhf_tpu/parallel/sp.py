@@ -50,19 +50,26 @@ def _ring_attn_fn(key_valid, axis_name, attn_impl: str, t_local: int):
     )
 
 
-def _sp_forward_local(params, config: ModelConfig, input_ids, attention_mask,
-                      position_ids, axis_name, lora_scale, remat,
-                      attn_impl: str = "xla"):
-    """Runs inside shard_map: the shared forward recipe with the attention
-    contraction routed around the ring (no duplicated embed/scan logic)."""
+def _sp_hidden_local(params, config: ModelConfig, input_ids, attention_mask,
+                     position_ids, axis_name, lora_scale, remat,
+                     attn_impl: str = "xla"):
+    """Runs inside shard_map: the shared forward recipe up to the final
+    hidden states, attention routed around the ring."""
     key_valid = attention_mask.astype(bool)
     ring_attn = _ring_attn_fn(key_valid, axis_name, attn_impl,
                               input_ids.shape[1])
-
-    x = _hidden_from_inputs(
+    return _hidden_from_inputs(
         params, config, jnp.where(key_valid, input_ids, 0), attention_mask,
         position_ids, lora_scale, remat, attn_fn=ring_attn,
     )
+
+
+def _sp_forward_local(params, config: ModelConfig, input_ids, attention_mask,
+                      position_ids, axis_name, lora_scale, remat,
+                      attn_impl: str = "xla"):
+    """Hidden states → vocab logits (no duplicated embed/scan logic)."""
+    x = _sp_hidden_local(params, config, input_ids, attention_mask,
+                         position_ids, axis_name, lora_scale, remat, attn_impl)
     return _logits(config, params, x)
 
 
@@ -133,7 +140,7 @@ def _gather_by_spec(tree, specs, axis_name: str, skip_leading_dim: bool = False)
 
 def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
                            params_local, input_ids, attention_mask, position_ids,
-                           attn_impl: str = "xla"):
+                           attn_impl: str = "xla", head: str = "lm"):
     """Inside shard_map over (fsdp, sp): sequence shard local, params shards
     gathered — embeddings up front (the lookup needs them), layer leaves one
     scan step at a time via the shared recipe's `layer_transform` hook, the
@@ -165,14 +172,24 @@ def _sp_fsdp_forward_local(config, specs, sp_axis, fsdp_axis, lora_scale, remat,
         position_ids, lora_scale, remat, attn_fn=ring_attn,
         layer_transform=gather_layer,
     )
+    norm_full = _gather_by_spec(params_local["norm"], specs["norm"], fsdp_axis)
+    if head == "score":
+        # value/RM head: final-normed hidden @ score — position-local, no
+        # cross-shard traffic (matches core.model.score_forward)
+        from nanorlhf_tpu.core.model import rms_norm
+
+        x = rms_norm(x, norm_full, config.rms_norm_eps)
+        score = _gather_by_spec(
+            params_local["score"], specs["score"], fsdp_axis
+        )
+        return x.astype(jnp.float32) @ score.astype(jnp.float32)
     # lm_head / final norm gathered only now (tied models reuse embed_full)
-    head = {"embed_tokens": embed_full,
-            "norm": _gather_by_spec(params_local["norm"], specs["norm"], fsdp_axis)}
+    head_tree = {"embed_tokens": embed_full, "norm": norm_full}
     if not config.tie_word_embeddings:
-        head["lm_head"] = _gather_by_spec(
+        head_tree["lm_head"] = _gather_by_spec(
             params_local["lm_head"], specs["lm_head"], fsdp_axis
         )
-    return _logits(config, head, x)
+    return _logits(config, head_tree, x)
 
 
 def sp_score_logprobs(
@@ -289,6 +306,57 @@ def sp_score_logprobs(
     # final global position has no next token
     lp = lp.at[:, -1].set(0.0)
     return (lp, ent) if with_entropy else lp
+
+
+def sp_score_values(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,   # [B, T] global, T divisible by sp axis
+    pad_token_id: int,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    fsdp_axis: str | None = None,
+    lora_scale: float = 1.0,
+    remat: bool = False,
+    attn_impl: str = "xla",
+) -> jnp.ndarray:
+    """Per-position value/RM scores [B, T, num_labels] under sequence
+    parallelism — `core.model.score_forward` at ring scale (the PPO value
+    pass, `PPO/ppo_trainer.py:630-634,732`, for beyond-one-device contexts).
+    The score head is position-local, so unlike logprob scoring nothing
+    crosses shard boundaries after the ring. Differentiable with the
+    default "xla" ring (the PPO update needs the value gradient); flash is
+    scoring-only."""
+    from nanorlhf_tpu.core.model import padding_inputs, rms_norm
+
+    _, attention_mask, position_ids = padding_inputs(query_responses, pad_token_id)
+    attention_mask = attention_mask.astype(jnp.int32)
+
+    if fsdp_axis is not None:
+        specs = _fsdp_specs(params, fsdp_axis)
+        fn = partial(_sp_fsdp_forward_local, config, specs, sp_axis,
+                     fsdp_axis, lora_scale, remat, attn_impl=attn_impl,
+                     head="score")
+        return shard_map(
+            fn, mesh=mesh,
+            in_specs=(specs, P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
+            out_specs=P(None, sp_axis, None),
+            check_vma=False,
+        )(params, query_responses, attention_mask, position_ids)
+
+    def fn(ids, mask, pos):
+        x = _sp_hidden_local(params, config, ids, mask, pos,
+                             axis_name=sp_axis, lora_scale=lora_scale,
+                             remat=remat, attn_impl=attn_impl)
+        x = rms_norm(x, params["norm"], config.rms_norm_eps)
+        return x.astype(jnp.float32) @ params["score"].astype(jnp.float32)
+
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, sp_axis), P(None, sp_axis), P(None, sp_axis)),
+        out_specs=P(None, sp_axis, None),
+        check_vma=False,
+    )(query_responses, attention_mask, position_ids)
 
 
 def sp_fsdp_forward_logits(

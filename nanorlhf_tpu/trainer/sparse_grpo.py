@@ -544,4 +544,8 @@ class SparseGRPOTrainer(RLTrainer):
                 )
         # train() returning implies checkpoints are durable (async saver)
         self.ckpt.wait()
+        if cfg.export_hf_dir and num_updates is None:
+            # handoff artifact (same contract as the dense runtime)
+            print(f"exporting HF checkpoint to {cfg.export_hf_dir}")
+            self.export_model(cfg.export_hf_dir)
         return self.state

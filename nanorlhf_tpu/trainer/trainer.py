@@ -467,10 +467,20 @@ class RLTrainer:
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
                 )
-                vpred = score_forward(
-                    train_tree["value"], mcfg, mb["query_responses"], pad_id,
-                    lora_scale=value_lora_scale, remat=remat,
-                )[:, context_length - 1 : -1, 0]
+                if sp_on:
+                    from nanorlhf_tpu.parallel.sp import sp_score_values
+
+                    # differentiated → keep the "xla" einsum ring
+                    vpred = sp_score_values(
+                        train_tree["value"], mcfg, mb["query_responses"],
+                        pad_id, sp_mesh, fsdp_axis=sp_fsdp_axis,
+                        lora_scale=value_lora_scale, remat=remat,
+                    )[:, context_length - 1 : -1, 0]
+                else:
+                    vpred = score_forward(
+                        train_tree["value"], mcfg, mb["query_responses"], pad_id,
+                        lora_scale=value_lora_scale, remat=remat,
+                    )[:, context_length - 1 : -1, 0]
                 vpred = jnp.where(mb["padding_mask_p1"], 0.0, vpred)
                 vf_loss, vf_aux = value_loss_clipped(
                     vpred, mb["values"], mb["returns"], ~mb["padding_mask_p1"],
@@ -547,11 +557,6 @@ class RLTrainer:
         on = self.mesh.shape.get("sp", 1) > 1
         if on and self.mesh.shape.get("tensor", 1) > 1:
             raise ValueError("sp > 1 with tensor > 1 is not supported")
-        if on and self.algo == AlgoName.PPO:
-            raise ValueError(
-                "sp > 1 is not supported for PPO yet (the value-head forward "
-                "has no sequence-parallel variant)"
-            )
         return on
 
     def _fsdp_axis(self):
@@ -1202,10 +1207,24 @@ class RLTrainer:
             mcfg, pad_id = self.mcfg, self.tokenizer.pad_token_id
             value_lora_scale = self.value_lora_scale
 
+            if self._sp_on():
+                from nanorlhf_tpu.parallel.sp import sp_score_values
+
+                mesh, fsdp_axis = self.mesh, self._fsdp_axis()
+                # scoring never differentiates → flash ring is legal
+                scorer = partial(
+                    sp_score_values, config=mcfg, pad_token_id=pad_id,
+                    mesh=mesh, fsdp_axis=fsdp_axis,
+                    lora_scale=value_lora_scale, attn_impl=mcfg.attention_impl,
+                )
+            else:
+                scorer = partial(score_forward, config=mcfg,
+                                 pad_token_id=pad_id,
+                                 lora_scale=value_lora_scale)
+
             @partial(jax.jit, static_argnums=(2,))
             def value_fn(vparams, qr_chunk, context_length: int):
-                v = score_forward(vparams, mcfg, qr_chunk, pad_id,
-                                  lora_scale=value_lora_scale)[:, :, 0]
+                v = scorer(vparams, query_responses=qr_chunk)[:, :, 0]
                 return v[:, context_length - 1 : -1]
 
             self._value_fn = value_fn

@@ -52,3 +52,26 @@ def test_reinforce_launcher_offline_tiny(tmp_path):
     state = run(cfg)
     assert state["episode"] == 16
     assert (tmp_path / "ep" / "metrics.jsonl").exists()
+
+
+def test_grpo_r1_prompt_cache(tmp_path, monkeypatch):
+    """build_prompt_dataset consults the token cache: second call with the
+    same corpus/tokenizer mmaps instead of re-encoding (encode disabled —
+    via monkeypatch, so the tokenizer IDENTITY in the fingerprint is
+    unchanged)."""
+    import numpy as np
+
+    from nanorlhf_tpu.data import ToyTokenizer
+    from nanorlhf_tpu.entrypoints.grpo_r1 import (
+        build_prompt_dataset, synthetic_math_corpus)
+
+    tok = ToyTokenizer(512)
+    qa = synthetic_math_corpus(24)
+    d1 = build_prompt_dataset(qa, tok, cache_dir=str(tmp_path))
+
+    def boom(*a, **k):
+        raise AssertionError("re-tokenized on a cache hit")
+
+    monkeypatch.setattr(ToyTokenizer, "encode", boom)
+    d2 = build_prompt_dataset(qa, tok, cache_dir=str(tmp_path))
+    np.testing.assert_array_equal(d1.input_ids, d2.input_ids)

@@ -74,3 +74,41 @@ def test_sp_score_flash_ring_matches(rng):
     want = np.asarray(sp_score_logprobs(params, config, qr, 0, 0.9, mesh))
     real = np.asarray(qr != 0)
     np.testing.assert_allclose(got * real, want * real, rtol=2e-4, atol=2e-4)
+
+
+def test_sp_score_values_matches_score_forward(rng):
+    """sp_score_values (PPO value head at ring scale): plain sp mesh AND the
+    fsdp-sharded head="score" branch, values + gradients vs score_forward."""
+    from nanorlhf_tpu.core.model import score_forward
+    from nanorlhf_tpu.parallel.sp import sp_score_values
+
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    params = {k: v for k, v in params.items() if k != "lm_head"}
+    params["score"] = jax.random.normal(
+        jax.random.PRNGKey(5), (config.hidden_size, 1), jnp.float32
+    ) * 0.1
+    ids = rng.integers(2, 128, size=(2, 32)).astype(np.int32)
+    ids[1, :5] = 0
+    qr = jnp.asarray(ids)
+    want = np.asarray(score_forward(params, config, qr, 0))
+
+    for mesh in (Mesh(np.asarray(jax.devices()[:2]), ("sp",)),
+                 Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                      ("fsdp", "sp"))):
+        fsdp = "fsdp" if "fsdp" in mesh.shape else None
+        got = np.asarray(sp_score_values(params, config, qr, 0, mesh,
+                                         fsdp_axis=fsdp))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+        def loss_sp(p):
+            return (sp_score_values(p, config, qr, 0, mesh,
+                                    fsdp_axis=fsdp) ** 2).mean()
+
+        def loss_ref(p):
+            return (score_forward(p, config, qr, 0) ** 2).mean()
+
+        g_sp = jax.jit(jax.grad(loss_sp))(params)["score"]
+        g_ref = jax.grad(loss_ref)(params)["score"]
+        np.testing.assert_allclose(np.asarray(g_sp), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-5)

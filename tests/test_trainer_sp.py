@@ -135,8 +135,7 @@ def test_dense_sp_capture_uses_sp_ref_scorer(tmp_path):
     assert np.isfinite(m[-1]["loss/policy_avg_new"])
 
 
-def test_ppo_with_sp_raises(tmp_path):
-    devs = jax.devices()
+def _ppo_fixtures(tmp_path, name, mesh):
     tok = ToyTokenizer(512)
     mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
     params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
@@ -146,20 +145,44 @@ def test_ppo_with_sp_raises(tmp_path):
     dataset = load_prompt_dataset("synthetic:32", tok, max_prompt_len=16)
     cfg = RLConfig(
         algo=AlgoName.PPO,
-        output_dir=str(tmp_path / "ppo_sp"),
+        output_dir=str(tmp_path / name),
         response_length=8,
-        total_episodes=4,
+        temperature=1.0,
+        total_episodes=2,
         per_device_train_batch_size=2,
         gradient_accumulation_steps=1,
         num_mini_batches=1,
+        learning_rate=1e-3,
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False,
         save_steps=0,
+        report_to="jsonl",
+        logging_steps=1,
     )
-    with pytest.raises(ValueError, match="PPO"):
-        RLTrainer(
-            cfg, mcfg, tok, params, dataset, det_reward,
-            value_params=vparams,
-            mesh=make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
-        )
+    return RLTrainer(cfg, mcfg, tok, params, dataset, det_reward,
+                     value_params=vparams, mesh=mesh)
+
+
+def test_ppo_sp2_matches_single_device(tmp_path):
+    """PPO under sp=2: the value pass (rollout prediction AND the
+    differentiated update forward) routes through sp_score_values; first
+    update must match single-device metrics (identical rollouts, ring only
+    reorders f32 reductions)."""
+    devs = jax.devices()
+    ctrl = _ppo_fixtures(tmp_path, "ppo_ctrl",
+                         make_mesh(MeshConfig(1, 1, 1, 1), devices=devs[:1]))
+    sp = _ppo_fixtures(tmp_path, "ppo_sp2",
+                       make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]))
+    assert sp._sp_on()
+    s1 = ctrl.train(num_updates=1)
+    s2 = sp.train(num_updates=1)
+    assert s1["global_step"] == s2["global_step"] == 1
+    m1 = _metric_rows(tmp_path / "ppo_ctrl")[0]
+    m2 = _metric_rows(tmp_path / "ppo_sp2")[0]
+    assert abs(m1["eval_objective/scores_old"] - m2["eval_objective/scores_old"]) < 1e-6
+    for key in ("loss/policy_avg_new", "loss/value_avg_new", "objective/kl_old"):
+        if key in m1:
+            assert abs(m1[key] - m2[key]) < 2e-3, (key, m1[key], m2[key])
 
 
 def test_sp_width_divisibility_enforced(tmp_path):
