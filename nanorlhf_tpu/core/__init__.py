@@ -1,4 +1,9 @@
 from nanorlhf_tpu.core.config import ModelConfig
+from nanorlhf_tpu.core.params import (
+    export_hf_checkpoint,
+    load_hf_checkpoint,
+    params_from_hf_state_dict,
+)
 from nanorlhf_tpu.core.model import (
     init_params,
     model_forward,
@@ -11,6 +16,9 @@ from nanorlhf_tpu.core.model import (
 )
 
 __all__ = [
+    "export_hf_checkpoint",
+    "load_hf_checkpoint",
+    "params_from_hf_state_dict",
     "ModelConfig",
     "init_params",
     "model_forward",

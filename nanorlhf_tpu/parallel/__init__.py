@@ -1,9 +1,10 @@
 from nanorlhf_tpu.parallel.mesh import MeshConfig, make_mesh, param_sharding_rules, shard_params, batch_sharding
-from nanorlhf_tpu.parallel.ring_attention import ring_attention
+from nanorlhf_tpu.parallel.ring_attention import ring_attention, ring_attention_flash
 from nanorlhf_tpu.parallel.sp import (
     sp_forward_logits,
     sp_fsdp_forward_logits,
     sp_score_logprobs,
+    sp_score_values,
 )
 from nanorlhf_tpu.parallel.distributed import initialize_multihost, broadcast_host_value
 
@@ -14,9 +15,11 @@ __all__ = [
     "shard_params",
     "batch_sharding",
     "ring_attention",
+    "ring_attention_flash",
     "sp_forward_logits",
     "sp_fsdp_forward_logits",
     "sp_score_logprobs",
+    "sp_score_values",
     "initialize_multihost",
     "broadcast_host_value",
 ]
