@@ -209,18 +209,23 @@ def make_accuracy_func(eval_qa, max_prompt_len: int = 512,
     return accuracy_func
 
 
-def main(cfg: RLConfig | None = None, limit: int | None = None):
+def main(cfg: RLConfig | None = None, limit: int | None = None,
+         max_prompt_len: int = 512, eval_response_length: int = 1024):
     cfg = cfg or build_config()
     mcfg, params, tokenizer = resolve_model(cfg.sft_model_path, cfg.seed)
     train_qa, eval_qa = load_math_datasets("meta-math/MetaMathQA", "HuggingFaceH4/MATH-500",
                                            limit=limit)
     train_index = dict(train_qa)
     dataset = build_prompt_dataset(train_qa, tokenizer,
+                                   max_prompt_len=max_prompt_len,
                                    cache_dir=cfg.dataset_cache_dir)
     trainer = SparseGRPOTrainer(
         cfg, mcfg, tokenizer, params, dataset,
         make_r1_reward(train_index),
-        accuracy_func=make_accuracy_func(eval_qa),
+        accuracy_func=make_accuracy_func(
+            eval_qa, max_prompt_len=max_prompt_len,
+            eval_response_length=eval_response_length,
+        ),
     )
     try:
         return trainer.train()

@@ -75,3 +75,47 @@ def test_grpo_r1_prompt_cache(tmp_path, monkeypatch):
     monkeypatch.setattr(ToyTokenizer, "encode", boom)
     d2 = build_prompt_dataset(qa, tok, cache_dir=str(tmp_path))
     np.testing.assert_array_equal(d1.input_ids, d2.input_ids)
+
+
+def test_grpo_r1_main_offline_e2e(tmp_path, monkeypatch):
+    """The full R1-Zero launcher path end to end, offline: synthetic math
+    corpus, templated+cached prompts, sparse GRPO updates with the r1
+    reward protocol, initial+periodic accuracy eval, and the HF handoff
+    export at the end of the run. The dataset load is PINNED to the
+    synthetic corpus — on a networked machine the fallback would otherwise
+    download the full MetaMathQA split before slicing."""
+    import os
+
+    from nanorlhf_tpu.entrypoints import grpo_r1
+    from nanorlhf_tpu.entrypoints.grpo_r1 import (
+        build_config, main, synthetic_math_corpus)
+
+    monkeypatch.setattr(
+        grpo_r1, "load_math_datasets",
+        lambda *a, limit=None, **k: (synthetic_math_corpus(24),
+                                     synthetic_math_corpus(8, seed=1)),
+    )
+
+    cfg = build_config()
+    cfg.sft_model_path = "tiny-demo"
+    cfg.output_dir = str(tmp_path / "r1")
+    cfg.dataset_cache_dir = str(tmp_path / "tok")
+    cfg.export_hf_dir = str(tmp_path / "hf")
+    cfg.response_length = 8
+    cfg.total_episodes = 8
+    cfg.per_device_train_batch_size = 1
+    cfg.gradient_accumulation_steps = 1
+    cfg.num_mini_batches = 1
+    cfg.sample_n = 2
+    cfg.learning_rate = 1e-4
+    cfg.lora_r, cfg.lora_alpha = 4, 8
+    cfg.gradient_checkpointing = False
+    cfg.save_steps = 0
+    cfg.eval_steps = 1
+    cfg.report_to = "none"
+    cfg.mesh = MeshConfig(-1, 1, 1)
+
+    state = main(cfg, limit=24, max_prompt_len=24, eval_response_length=8)
+    assert state["episode"] >= 8
+    assert os.path.exists(os.path.join(cfg.export_hf_dir, "model.safetensors"))
+    assert os.listdir(cfg.dataset_cache_dir)  # token cache was written
