@@ -84,6 +84,13 @@ def normalize_math_answer(ans: str) -> str:
     s = s.replace("\\left", "").replace("\\right", "")
     s = s.replace("\\!", "").replace("\\,", "").replace("\\;", "").replace("\\:", "")
     s = s.replace("\\$", "").replace("\\%", "").replace("%", "")
+    # trailing units: "5\text{ cm}" / "12 \text{ cm}^2" -> "5" / "12" (the
+    # MATH-toolkit remove-right-units behavior). A PURE text answer
+    # ("\text{east}") has nothing before the block and is left for the
+    # wrapper stripping below.
+    m = re.match(r"^(.*\S)\s*\\text\{[^{}]*\}(\s*\^\{?\d+\}?)?\s*$", s)
+    if m and m.group(1).strip():
+        s = m.group(1)
     for cmd in _TEXT_CMDS:
         s = _strip_cmd_wrapper(s, cmd)
     s = s.replace("^{\\circ}", "").replace("^\\circ", "")
@@ -121,15 +128,19 @@ def _latex_to_sympy_str(s: str) -> str:
     # mixed numbers first: [-]N\frac{a}{b} means ±(N + a/b) — the sign
     # applies to the whole mixed number, so -1\frac{1}{2} = -1.5, not -0.5
     mixed = re.compile(r"(-?)(\d+)\\frac\{([^{}]*)\}\{([^{}]*)\}")
-    while mixed.search(out):
-        out = mixed.sub(r"\1((\2)+((\3)/(\4)))", out)
-    # \frac{a}{b} -> (a)/(b), applied repeatedly for nesting
     frac = re.compile(r"\\frac\{([^{}]*)\}\{([^{}]*)\}")
-    while frac.search(out):
-        out = frac.sub(r"((\1)/(\2))", out)
     sqrt = re.compile(r"\\sqrt\{([^{}]*)\}")
-    while sqrt.search(out):
+    # one FIXPOINT over all three: each pattern only matches brace-free
+    # arguments, so nesting (\frac{\sqrt{3}}{3}, \sqrt{\frac{1}{2}}) must
+    # convert innermost-first across patterns — separate per-pattern loops
+    # left nested forms half-converted into sympy garbage
+    while True:
+        prev = out
+        out = mixed.sub(r"\1((\2)+((\3)/(\4)))", out)
+        out = frac.sub(r"((\1)/(\2))", out)
         out = sqrt.sub(r"sqrt(\1)", out)
+        if out == prev:
+            break
     out = out.replace("\\pi", "pi").replace("\\infty", "oo")
     out = out.replace("^", "**")
     out = out.replace("{", "(").replace("}", ")")
@@ -353,6 +364,23 @@ def _equation_equal(a: str, b: str) -> bool | None:
     return None
 
 
+def _split_top_level(s: str, sep: str = ",") -> list[str]:
+    """Split on `sep` only at bracket depth 0 (over (), [], {})."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
 def math_answers_equal(
     pred: str, gt: str, percent_variants: bool = False
 ) -> bool:
@@ -377,8 +405,11 @@ def math_answers_equal(
         return num
 
     a_s, b_s = _light_clean(pred), _light_clean(gt)
-    # set unions: order-free bipartite coverage of the pieces, matching
-    # `eval_script.is_correct:28-33` (which recurses into the list path)
+    # set unions FIRST: order-free bipartite coverage of the pieces,
+    # matching `eval_script.is_correct:28-33` (which recurses into the list
+    # path). Must run before the brace-set branch — "\{1\}\cup\{2\}" both
+    # starts with \{ and ends with \}, and treating the whole union as one
+    # set mangles its elements.
     if "\\cup" in a_s or "\\cup" in b_s:
         pa, pb = a_s.split("\\cup"), b_s.split("\\cup")
         if len(pa) != len(pb):
@@ -387,6 +418,17 @@ def math_answers_equal(
             any(math_answers_equal(x, y) for y in pb) for x in pa
         ) and all(
             any(math_answers_equal(x, y) for x in pa) for y in pb
+        )
+    # finite brace sets \{...\}: order-free symmetric coverage of the
+    # TOP-LEVEL elements ({1,2} == {2,1} — FiniteSet semantics; elements
+    # may themselves be tuples/intervals, so the comma split is depth-aware)
+    if (a_s.startswith("\\{") and a_s.endswith("\\}")
+            and b_s.startswith("\\{") and b_s.endswith("\\}")):
+        ea = [x for x in _split_top_level(a_s[2:-2]) if x.strip()]
+        eb = [x for x in _split_top_level(b_s[2:-2]) if x.strip()]
+        return (
+            all(any(math_answers_equal(x, y) for y in eb) for x in ea)
+            and all(any(math_answers_equal(x, y) for x in ea) for y in eb)
         )
     # matrices: rows by \\\\, columns by &, env type ignored
     # (`eval_utils.math_equal:233-253`)

@@ -63,6 +63,22 @@ EQUIV_GOLDEN = [
     ("\\frac{1}{2}", "0.5", True),
     ("\\sqrt{8}", "2\\sqrt{2}", True),
     ("1{,}000", "1000", True),
+    # --- r3 additions: nested radicals/fractions (fixpoint latex→sympy),
+    #     trailing units, finite brace sets, assorted reference shapes ---
+    ("\\frac{\\sqrt{3}}{3}", "\\frac{1}{\\sqrt{3}}", True),
+    ("\\sqrt{\\frac{1}{4}}", "0.5", True),
+    ("\\frac{\\frac{1}{2}}{2}", "0.25", True),
+    ("5\\text{ cm}", "5", True),
+    ("12 \\text{ cm}^2", "12", True),
+    ("\\{1, 2\\}", "\\{2, 1\\}", True),
+    ("\\{1, 2\\}", "\\{1, 3\\}", False),
+    ("\\{1\\}", "\\{1, 2\\}", False),
+    ("\\dfrac{3}{4}", "0.75", True),
+    ("2\\frac{1}{2}", "2.5", True),
+    ("90^\\circ", "90", True),
+    ("1.5\\times10^3", "1500", True),
+    ("\\pm\\sqrt{2}", "\\sqrt{2}, -\\sqrt{2}", True),
+    ("x^2+2x+1", "(x+1)^2", True),
 ]
 
 
@@ -210,3 +226,14 @@ def test_extractor_name_normalization():
     assert get_extractor("MATH500") is extract_math_answer
     assert get_extractor("math-500") is extract_math_answer
     assert get_extractor("gsm8k_test") is extract_gsm_few_shot_cot_answer
+
+
+def test_brace_set_edge_cases():
+    """Review regressions: sets of tuples must not fragment and cross-match,
+    and unions of brace sets keep union (not set) semantics."""
+    from nanorlhf_tpu.rewards.math_grader import math_answers_equal as eq
+
+    assert not eq("\\{(1,2),(3,4)\\}", "\\{(1,4),(3,2)\\}")
+    assert eq("\\{(1,2),(3,4)\\}", "\\{(3,4),(1,2)\\}")
+    assert eq("\\{1\\}\\cup\\{2\\}", "\\{2\\}\\cup\\{1\\}")
+    assert not eq("\\{[1,2],[3,4]\\}", "\\{[1,4],[3,2]\\}")
