@@ -24,7 +24,10 @@ BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
 BENCH_ATTENTION (xla | pallas | auto), BENCH_LORA (1 | 0),
 BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
 BENCH_KV_QUANT (0 | 1: int8 KV cache),
-BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (1500 s per attempt),
+BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
+a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
+>40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
+report the faster config),
 BENCH_ALLOW_CPU_FALLBACK (1: after all TPU attempts fail, run a reduced
 bench on CPU and mark backend=cpu in the payload rather than emitting
 nothing).
@@ -129,7 +132,8 @@ def _tunnel_alive() -> bool | None:
 def orchestrate() -> int:
     """Parent entry: spawn children with retry/backoff, emit ONE JSON line."""
     attempts = int(os.environ.get("BENCH_ATTEMPTS", 2))
-    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 1500))
+    # generous: the child may measure TWO configs (baseline + int8 sweep)
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2100))
     allow_cpu = os.environ.get("BENCH_ALLOW_CPU_FALLBACK", "1") == "1"
 
     errors = []
@@ -452,8 +456,19 @@ def run_bench(jax, init_error):
             },
         }
 
+    t_baseline = time.time()
     chosen = measure(rollout_quant, kv_cache_quant, rollout_ahead)
+    t_baseline = time.time() - t_baseline
     sweep_detail = None
+    # the lever config recompiles everything (≈ another baseline's worth of
+    # wall time) — skip when that would risk the parent's attempt timeout
+    # eating the numbers we already have
+    budget = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2100))
+    if sweep and t_baseline > 0.4 * budget:
+        sweep = False
+        sweep_detail = {
+            "skipped": f"baseline took {t_baseline:.0f}s of {budget:.0f}s budget"
+        }
     if sweep:
         try:
             lever = measure("int8", "int8", rollout_ahead)
