@@ -20,7 +20,10 @@ jax process ever exists at a time — required by the axon TPU tunnel, which
 allows a single claim holder and can wedge if probed concurrently.
 
 Env overrides: BENCH_PROMPTS (default 32), BENCH_SAMPLE_N (4),
-BENCH_RESPONSE (256), BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
+BENCH_RESPONSE (1500 — the reference's operating point, so `value` and
+`vs_baseline` compare like with like; a resp-256 secondary point is
+measured into detail.short_response when the budget allows),
+BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
 BENCH_ATTENTION (xla | pallas | auto), BENCH_LORA (1 | 0),
 BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
 BENCH_KV_QUANT (0 | 1: int8 KV cache),
@@ -42,6 +45,8 @@ import time
 import numpy as np
 
 BASELINE_EPS_PER_SEC = 1.0  # reference: ~1 s/episode on one A100 40G
+
+_T0 = time.time()  # child-process start (budget accounting for secondaries)
 
 # peak dense bf16 FLOPs/s per chip by device kind (public figures; substring
 # match on jax Device.device_kind). MFU = achieved model FLOPs / peak.
@@ -345,7 +350,11 @@ def run_bench(jax, init_error):
 
     n_prompts = int(os.environ.get("BENCH_PROMPTS", 32))
     sample_n = int(os.environ.get("BENCH_SAMPLE_N", 4))
-    response_len = int(os.environ.get("BENCH_RESPONSE", 256))
+    # default = the reference's operating point (response_length 1500,
+    # `/root/reference/README.md:36`): `value`/`vs_baseline` must compare
+    # like with like (VERDICT r3 #8) — a resp-256 headline overstates parity
+    # against a resp-1500 A100 baseline
+    response_len = int(os.environ.get("BENCH_RESPONSE", 1500))
     model_name = os.environ.get(
         "BENCH_MODEL", "tiny" if on_cpu_fallback else "1_5b"
     )
@@ -405,13 +414,14 @@ def run_bench(jax, init_error):
     dataset = load_prompt_dataset(f"synthetic:{max(64, n_prompts * 2)}", tok,
                                   max_prompt_len=64)
 
-    def measure(r_quant, kv_quant, ahead):
+    def measure(r_quant, kv_quant, ahead, resp=None):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict."""
+        resp = response_len if resp is None else resp
         cfg = RLConfig(
             algo=AlgoName.GRPO,
             output_dir="/tmp/nanorlhf_tpu_bench",
-            response_length=response_len,
+            response_length=resp,
             temperature=0.9,
             sample_n=sample_n,
             per_device_train_batch_size=per_dev,
@@ -445,6 +455,7 @@ def run_bench(jax, init_error):
             "rollout_quant": r_quant,
             "kv_cache_quant": kv_quant,
             "rollout_ahead": ahead,
+            "response_length": resp,
             "sec_per_update_steady": round(sec, 3),
             "compile_update_sec": round(times[0], 3),
             # cfg.batch_size (set by finalize inside RLTrainer) is the TRUE
@@ -480,6 +491,38 @@ def run_bench(jax, init_error):
                 chosen = lever
         except Exception as e:  # lever failed: keep the measured baseline
             sweep_detail = {"int8_error": f"{type(e).__name__}: {e}"[:300]}
+
+    # secondary short-response point (the r1/r2 rounds' resp-256 shape) so
+    # the payload carries BOTH operating points — the resp-1500 headline
+    # stays baseline-comparable and the short point tracks decode-lever
+    # progress round over round. Skipped when the remaining budget can't
+    # absorb another full compile, or when the caller pinned BENCH_RESPONSE
+    # at/below the short width already.
+    # reserve ~a baseline's worth of time for the short point itself (its
+    # compile cost matches the baseline's even though its decode is shorter)
+    # — launching it into insufficient budget would let the parent timeout
+    # kill the child and lose the already-measured headline numbers
+    short_detail = None
+    if (
+        backend == "tpu"
+        and response_len > 256
+        and budget - (time.time() - _T0) > 0.9 * t_baseline
+    ):
+        try:
+            short = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"], resp=256,
+            )
+            short_detail = {
+                "response_length": 256,
+                "sec_per_update_steady": short["sec_per_update_steady"],
+                "episodes_per_sec_per_chip": round(
+                    short["episodes_per_update"]
+                    / short["sec_per_update_steady"] / n_dev, 4,
+                ),
+            }
+        except Exception as e:
+            short_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     sec_per_update = chosen["sec_per_update_steady"]
     episodes_per_update = chosen["episodes_per_update"]
@@ -540,12 +583,17 @@ def run_bench(jax, init_error):
     }
     if sweep_detail is not None:
         detail["sweep"] = sweep_detail
+    if short_detail is not None:
+        detail["short_response"] = short_detail
     if init_error is not None:
         detail["tpu_init_error"] = init_error[-500:]
 
     # vs_baseline only means something for the flagship model on real TPU
-    # silicon; a tiny-model CPU-fallback number must not claim a beat
-    comparable = backend == "tpu" and model_name == "1_5b"
+    # silicon AT the baseline's operating point (response_length 1500) — a
+    # tiny-model CPU fallback or a short-response run must not claim a beat
+    comparable = (
+        backend == "tpu" and model_name == "1_5b" and response_len >= 1500
+    )
     payload = {
         "metric": "grpo_episodes_per_sec_per_chip",
         "value": round(eps_per_sec_per_chip, 4),
@@ -559,7 +607,8 @@ def run_bench(jax, init_error):
     if not comparable:
         detail["vs_baseline_note"] = (
             "0.0: run not comparable to the A100 baseline "
-            f"(backend={backend}, model={model_name})"
+            f"(backend={backend}, model={model_name}, "
+            f"response_length={response_len})"
         )
     if init_error is not None:
         payload["error"] = f"TPU unavailable, CPU fallback: {init_error[-300:]}"

@@ -29,6 +29,11 @@ class ModelConfig:
     # The decoder treats biases as optional, so this only steers random init
     # (HF loading is data-driven off the state dict).
     attention_bias: bool = True
+    # HF family slug ("qwen2" | "llama"); carried through load → export so a
+    # round-trip re-emits the source architecture instead of inferring it
+    # from attention_bias (a Llama with attention_bias=True is valid, ADVICE
+    # r3). None (random-init configs) falls back to the bias heuristic.
+    model_type: Optional[str] = None
     # "int8": the sampler's KV cache stores int8 values + per-token-per-head
     # bf16 scales (absmax over head_dim). At long responses the cache read is
     # the dominant decode HBM stream (≈7.5 GB/step at 8k tokens, batch 32);
@@ -131,6 +136,7 @@ class ModelConfig:
             tie_word_embeddings=True,
             max_position_embeddings=131072,
             attention_bias=False,
+            model_type="llama",
         )
 
     @classmethod
@@ -147,6 +153,7 @@ class ModelConfig:
             tie_word_embeddings=False,
             max_position_embeddings=131072,
             attention_bias=False,
+            model_type="llama",
         )
 
     @classmethod
@@ -172,4 +179,5 @@ class ModelConfig:
             tie_word_embeddings=get("tie_word_embeddings", False),
             max_position_embeddings=get("max_position_embeddings", 32768),
             attention_bias=bool(attn_bias),
+            model_type=model_type,
         )

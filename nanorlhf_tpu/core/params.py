@@ -164,10 +164,20 @@ def export_hf_checkpoint(
         if hasattr(tokenizer, "save_pretrained"):
             tokenizer.save_pretrained(out_dir)
 
-    is_llama = not config.attention_bias
+    # echo the source family when the config carries one (from_hf_config /
+    # load_hf_checkpoint set it); the attention_bias heuristic is only the
+    # random-init fallback (ADVICE r3: a Llama with attention_bias=True
+    # must not round-trip to Qwen2)
+    # only the two families this exporter can faithfully emit: an unknown
+    # slug (e.g. "mistral") must NOT be echoed verbatim — transformers'
+    # AutoConfig would apply that family's defaults (sliding_window, ...)
+    # to keys we never write, silently diverging from the source weights
+    family = config.model_type if config.model_type in ("qwen2", "llama") \
+        else ("qwen2" if config.attention_bias else "llama")
+    arch = {"qwen2": "Qwen2ForCausalLM", "llama": "LlamaForCausalLM"}[family]
     hf_config = {
-        "architectures": ["LlamaForCausalLM" if is_llama else "Qwen2ForCausalLM"],
-        "model_type": "llama" if is_llama else "qwen2",
+        "architectures": [arch],
+        "model_type": family,
         "vocab_size": config.vocab_size,
         "hidden_size": config.hidden_size,
         "intermediate_size": config.intermediate_size,

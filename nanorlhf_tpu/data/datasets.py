@@ -195,16 +195,42 @@ def load_prompt_dataset(
     with identical (source, split, limit, seed, max len, tokenizer) mmap
     the encoded corpus instead of re-tokenizing it.
     """
+    # HF sources load their texts BEFORE the cache check so the fingerprint
+    # can cover the corpus CONTENT, not just its name: an upstream revision
+    # change (or a cache dir shared across hosts with different local
+    # snapshots) must miss and re-tokenize, the same way
+    # grpo_r1.build_prompt_dataset hashes its corpus (ADVICE r3). The cache
+    # still skips the expensive half (templating + tokenization, ~50× the
+    # raw-text scan); `_load_hf_dataset` is offline-first, so a warm HF
+    # cache keeps working without network. `synthetic:` corpora are fully
+    # determined by (name, seed, tokenizer identity), so they keep the
+    # load-free params-only fast path.
+    texts = None
+    if not name.startswith("synthetic"):
+        ds = _load_hf_dataset(name, split)
+        texts = [extract_hh_question(row["chosen"]) for row in ds]
+        if limit:
+            texts = texts[:limit]
+
     cache_path = fp = None
     if cache_dir is not None:
+        import hashlib
+
         from nanorlhf_tpu.data.token_cache import (
             corpus_fingerprint, load_token_cache, save_token_cache,
             tokenizer_identity)
 
-        fp = corpus_fingerprint(
+        fp_kw = dict(
             name=name, split=split, limit=limit, seed=seed,
             max_prompt_len=max_prompt_len, tok=tokenizer_identity(tokenizer),
         )
+        if texts is not None:
+            h = hashlib.blake2b(digest_size=8)
+            for t in texts:
+                h.update(t.encode())
+                h.update(b"\x1f")
+            fp_kw["content"] = h.hexdigest()
+        fp = corpus_fingerprint(**fp_kw)
         cache_path = os.path.join(cache_dir, f"prompts-{fp:016x}.tok")
         cached = load_token_cache(cache_path, fp)
         if cached is not None:
@@ -212,15 +238,11 @@ def load_prompt_dataset(
                 _left_pad(cached, tokenizer.pad_token_id), tokenizer.pad_token_id
             )
 
-    if name.startswith("synthetic"):
+    if texts is None:
         _, _, count = name.partition(":")
         texts = synthetic_prompts(int(count) if count else 512, tokenizer, seed)
-    else:
-        ds = _load_hf_dataset(name, split)
-        texts = [extract_hh_question(row["chosen"]) for row in ds]
-
-    if limit:
-        texts = texts[:limit]
+        if limit:
+            texts = texts[:limit]
 
     templated = [
         tokenizer.apply_chat_template(

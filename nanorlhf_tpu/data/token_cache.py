@@ -86,8 +86,16 @@ def _read_py(path: str, fingerprint: int):
         magic, n, fp = _HEADER.unpack(head)
         if magic != _MAGIC or fp != (fingerprint & (2**64 - 1)):
             return None
+        # bound the u64 n_rows BEFORE any offset arithmetic: a corrupt
+        # header otherwise overflows the memmap length (OverflowError, not
+        # the ValueError the old catch assumed) — mirror of the native
+        # reader's check (ADVICE r3)
+        if n >= (size - _HEADER.size) // 8:
+            return None
         offsets = np.memmap(path, "<i8", "r", _HEADER.size, (n + 1,))
         total = int(offsets[n])
+        if total < 0:
+            return None
         expect = _HEADER.size + (n + 1) * 8 + total * 4
         if size != expect:
             return None

@@ -48,6 +48,14 @@ def build_config(sequence_parallel: int = 1,
         response_length=8000,                     # (`grpo_r1.py:145`)
         kl_coef=0.0,                              # (`grpo_r1.py:138`)
         temperature=0.9,
+        # exact full-vocab nucleus, matching the reference's untruncated
+        # vLLM top_p (`grpo_r1.py:127` via vllm SamplingParams): a BASE
+        # model at temp 0.9 is exactly the high-entropy regime where the
+        # 0.95-nucleus can exceed a fixed top-k early in training, and a
+        # k=64 pre-trim would silently narrow exploration (VERDICT r3 #6).
+        # Costs a full-vocab sort per decode step; instruction-tuned
+        # launchers keep the k=64 fast path.
+        rollout_top_k=0,
         sample_n=4,
         learning_rate=6e-6,
         per_device_train_batch_size=4,

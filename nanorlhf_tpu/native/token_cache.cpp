@@ -72,16 +72,33 @@ int token_cache_stat(const char* path, uint64_t fingerprint, int64_t* n_rows,
   Header h;
   int64_t first_last[1];
   int rc = -2;
+  struct stat st;
   if (fread(&h, sizeof(h), 1, f) == 1 && h.magic == kMagic &&
-      h.fingerprint == fingerprint) {
-    // last offset sits right before the token payload
-    if (fseek(f, sizeof(Header) + h.n_rows * sizeof(int64_t), SEEK_SET) == 0 &&
-        fread(first_last, sizeof(int64_t), 1, f) == 1) {
-      struct stat st;
+      h.fingerprint == fingerprint && fstat(fileno(f), &st) == 0 &&
+      // bound n_rows BEFORE any offset arithmetic: a corrupt header's u64
+      // n_rows can overflow the signed fseek offset (UB) and the expected
+      // size computation (ADVICE r3). The offsets table alone needs
+      // (n_rows+1)*8 bytes inside the file.
+      st.st_size >= static_cast<int64_t>(sizeof(Header) + sizeof(int64_t)) &&
+      h.n_rows < (static_cast<uint64_t>(st.st_size) - sizeof(Header)) /
+                     sizeof(int64_t)) {
+    // last offset sits right before the token payload; bound it against
+    // the space actually left for the payload BEFORE the *4 multiply — a
+    // corrupt value near 2^62 would otherwise wrap the uint64 product back
+    // onto the true file size and hand the caller a view spanning ~2^64
+    // bytes past the mapping
+    int64_t payload_cap = (st.st_size - static_cast<int64_t>(sizeof(Header)) -
+                           static_cast<int64_t>((h.n_rows + 1) *
+                                                sizeof(int64_t))) /
+                          static_cast<int64_t>(sizeof(int32_t));
+    if (payload_cap >= 0 &&
+        fseek(f, sizeof(Header) + h.n_rows * sizeof(int64_t), SEEK_SET) == 0 &&
+        fread(first_last, sizeof(int64_t), 1, f) == 1 &&
+        first_last[0] >= 0 && first_last[0] <= payload_cap) {
       int64_t expect = sizeof(Header) +
                        (h.n_rows + 1) * sizeof(int64_t) +
                        first_last[0] * sizeof(int32_t);
-      if (fstat(fileno(f), &st) == 0 && st.st_size == expect) {
+      if (st.st_size == expect) {
         *n_rows = static_cast<int64_t>(h.n_rows);
         *total_tokens = first_last[0];
         rc = 0;

@@ -17,6 +17,8 @@ in-chunk causal mask, past chunks attend fully.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -98,47 +100,34 @@ def _merge_partials(o1, l1, o2, l2):
     return o, m + jnp.log(den)
 
 
-def ring_attention_flash(
-    q: jnp.ndarray,           # [B, H, T_local, d]
-    k: jnp.ndarray,           # [B, KV, T_local, d]
-    v: jnp.ndarray,           # [B, KV, T_local, d]
-    key_valid: jnp.ndarray,   # [B, T_local] bool
-    axis_name: str,
-    causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
-) -> jnp.ndarray:
-    """FORWARD-ONLY ring attention with the Pallas flash kernel per chunk.
-
-    Each ring step runs the flash kernel on (my Q shard, incoming K/V chunk)
-    and merges the per-chunk (out, lse) partials flash-decoding style — the
-    O(T_local²) f32 score tensor of the einsum ring never materializes, and
-    the chunk attention itself rides the MXU-tuned kernel (21× the XLA
-    einsum at 8k on v5e). Chunk causality follows global positions: the
-    diagonal chunk is in-kernel causal, past chunks attend fully, future
-    chunks are skipped outright (three lax.switch branches).
-
-    No backward: the flash (out, lse) pair has no registered VJP here —
-    differentiating through this raises. Use it for SCORING passes only;
-    the update path keeps the einsum ring (`ring_attention`).
-    """
-    from nanorlhf_tpu.ops.attention import _flash_forward, _interpret_default
+def _ring_block(block_q: int, block_k: int, T: int):
+    """flash_attention's pad-up recipe (ops/attention.py): blocks must be
+    128-lane multiples and T must pad UP to a block multiple — a
+    non-aligned T_local is rejected by Mosaic, and an unpadded partial
+    last block would read out-of-bounds keys that key_valid does not
+    neutralize (silent wrong logprobs on silicon; interpret mode
+    zero-fills and cannot catch it)."""
     from jax.experimental import pallas as pl
+
+    block = max(block_q, block_k)
+    block = max(128, (block // 128) * 128)
+    block = min(block, 128 * int(pl.cdiv(T, 128)))
+    T_pad = int(pl.cdiv(T, block) * block)
+    return block, T_pad
+
+
+def _ring_flash_fwd_loop(q, k, v, key_valid, axis_name, causal, block_q,
+                         block_k):
+    """The flash ring forward: per-chunk Pallas kernel + lse merge. Returns
+    (out_f32 [B,H,T,d], lse [B,H,T] f32) — lse is the GLOBAL logsumexp over
+    the full (sharded) sequence, the backward residual."""
+    from nanorlhf_tpu.ops.attention import _flash_forward, _interpret_default
 
     my_idx = jax.lax.axis_index(axis_name)
     n = jax.lax.psum(1, axis_name)
     B, H, T, d = q.shape
     interpret = _interpret_default()
-    # flash_attention's pad-up recipe (ops/attention.py): blocks must be
-    # 128-lane multiples and T must pad UP to a block multiple — a
-    # non-aligned T_local is rejected by Mosaic, and an unpadded partial
-    # last block would read out-of-bounds keys that key_valid does not
-    # neutralize (silent wrong logprobs on silicon; interpret mode
-    # zero-fills and cannot catch it)
-    block = max(block_q, block_k)
-    block = max(128, (block // 128) * 128)
-    block = min(block, 128 * int(pl.cdiv(T, 128)))
-    T_pad = int(pl.cdiv(T, block) * block)
+    block, T_pad = _ring_block(block_q, block_k, T)
     q_pad = q
     if T_pad != T:
         q_pad = jnp.pad(q, [(0, 0), (0, 0), (0, T_pad - T), (0, 0)])
@@ -183,5 +172,148 @@ def ring_attention_flash(
 
     o0 = jnp.zeros(q.shape, jnp.float32)
     l0 = jnp.full((B, H, T), _LSE_FLOOR, jnp.float32)
-    o, _, *_ = jax.lax.fori_loop(0, n, step, (o0, l0, k, v, key_valid))
+    o, lse, *_ = jax.lax.fori_loop(0, n, step, (o0, l0, k, v, key_valid))
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_flash_core(q, k, v, key_valid, axis_name, causal, block_q, block_k):
+    o, _ = _ring_flash_fwd_loop(q, k, v, key_valid, axis_name, causal,
+                                block_q, block_k)
     return o.astype(q.dtype)
+
+
+def _ring_core_fwd(q, k, v, key_valid, axis_name, causal, block_q, block_k):
+    o, lse = _ring_flash_fwd_loop(q, k, v, key_valid, axis_name, causal,
+                                  block_q, block_k)
+    out = o.astype(q.dtype)
+    # `out` is saved in the RETURNED dtype so the backward's delta
+    # (Σ dO·O) uses the same values downstream gradients were computed from
+    return out, (q, k, v, key_valid, out, lse)
+
+
+def _ring_core_bwd(axis_name, causal, block_q, block_k, residuals, g):
+    """Ring backward with the fused Pallas flash-bwd kernels per chunk.
+
+    FlashAttention-2's backward identity with the GLOBAL lse:
+    p_chunk = exp(s_chunk − lse_global) is the true attention probability of
+    this chunk's keys, so each ring step runs `ops.attention._flash_backward`
+    on (my Q shard, visiting K/V chunk) with the global (out, lse, dO) and
+    yields exact dq contributions (summed locally) and the chunk's dk/dv
+    (accumulated in f32 carried around the ring WITH the chunk — after n
+    hops both land back on the chunk's owner). The O(T_local²) f32 score
+    tensor of the einsum ring never materializes in either direction.
+    """
+    from nanorlhf_tpu.ops.attention import (
+        _LANES,
+        _flash_backward,
+        _interpret_default,
+    )
+
+    q, k, v, key_valid, out, lse = residuals
+    my_idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    B, H, T, d = q.shape
+    KV = k.shape[1]
+    interpret = _interpret_default()
+    block, T_pad = _ring_block(block_q, block_k, T)
+
+    pad4 = [(0, 0), (0, 0), (0, T_pad - T), (0, 0)]
+    q_pad, out_pad, g_pad, lse_pad = q, out, g, lse
+    if T_pad != T:
+        q_pad = jnp.pad(q, pad4)
+        out_pad = jnp.pad(out, pad4)
+        g_pad = jnp.pad(g.astype(out.dtype), pad4)
+        lse_pad = jnp.pad(lse, [(0, 0), (0, 0), (0, T_pad - T)])
+    else:
+        g_pad = g.astype(out.dtype)
+    # the bwd kernels read lse lane-expanded (ops/attention.py layout)
+    lse_lanes = jnp.broadcast_to(
+        lse_pad[..., None], (B, H, T_pad, _LANES)
+    ).astype(jnp.float32)
+
+    def chunk_bwd(causal_chunk, k_cur, v_cur, valid_cur):
+        if T_pad != T:
+            k_cur = jnp.pad(k_cur, pad4)
+            v_cur = jnp.pad(v_cur, pad4)
+            valid_cur = jnp.pad(valid_cur, [(0, 0), (0, T_pad - T)])
+        dq_c, dk_c, dv_c = _flash_backward(
+            q_pad, k_cur, v_cur, valid_cur, out_pad, lse_lanes, g_pad,
+            causal_chunk, block, block, interpret,
+        )
+        return (dq_c[:, :, :T].astype(jnp.float32),
+                dk_c[:, :, :T].astype(jnp.float32),
+                dv_c[:, :, :T].astype(jnp.float32))
+
+    def skip_bwd(k_cur, v_cur, valid_cur):
+        return (jnp.zeros((B, H, T, d), jnp.float32),
+                jnp.zeros((B, KV, T, d), jnp.float32),
+                jnp.zeros((B, KV, T, d), jnp.float32))
+
+    def step(s, carry):
+        dq_acc, dk_rot, dv_rot, k_cur, v_cur, valid_cur = carry
+        src = (my_idx - s) % n
+        branch = jnp.where(src == my_idx, 2,
+                           jnp.where(src < my_idx, 1, 0)) if causal else \
+            jnp.int32(1)
+        dq_i, dk_i, dv_i = jax.lax.switch(
+            branch,
+            [skip_bwd,
+             lambda k_, v_, m_: chunk_bwd(False, k_, v_, m_),
+             lambda k_, v_, m_: chunk_bwd(True, k_, v_, m_)],
+            k_cur, v_cur, valid_cur,
+        )
+        dq_acc = dq_acc + dq_i
+        dk_rot = dk_rot + dk_i
+        dv_rot = dv_rot + dv_i
+        # rotate the chunk AND its gradient accumulators together: after n
+        # hops the (k, v, dk, dv) quadruple is back at the chunk's owner
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        valid_nxt = jax.lax.ppermute(valid_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_rot, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_rot, axis_name, perm)
+        return dq_acc, dk_nxt, dv_nxt, k_nxt, v_nxt, valid_nxt
+
+    dq0 = jnp.zeros((B, H, T, d), jnp.float32)
+    dkv0 = jnp.zeros((B, KV, T, d), jnp.float32)
+    dq, dk, dv, *_ = jax.lax.fori_loop(
+        0, n, step, (dq0, dkv0, dkv0, k, v, key_valid)
+    )
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), None)
+
+
+_ring_flash_core.defvjp(_ring_core_fwd, _ring_core_bwd)
+
+
+def ring_attention_flash(
+    q: jnp.ndarray,           # [B, H, T_local, d]
+    k: jnp.ndarray,           # [B, KV, T_local, d]
+    v: jnp.ndarray,           # [B, KV, T_local, d]
+    key_valid: jnp.ndarray,   # [B, T_local] bool
+    axis_name: str,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """Ring attention with the Pallas flash kernel per chunk — differentiable.
+
+    Each ring step runs the flash kernel on (my Q shard, incoming K/V chunk)
+    and merges the per-chunk (out, lse) partials flash-decoding style — the
+    O(T_local²) f32 score tensor of the einsum ring never materializes, and
+    the chunk attention itself rides the MXU-tuned kernel (21× the XLA
+    einsum at 8k on v5e). Chunk causality follows global positions: the
+    diagonal chunk is in-kernel causal, past chunks attend fully, future
+    chunks are skipped outright (three lax.switch branches).
+
+    The backward (`_ring_core_bwd`) re-runs the ring through the fused
+    Pallas flash-bwd kernels with the global lse, so both the SP scoring
+    pass and the SP update pass can use the same kernels — no
+    scoring/update kernel-mismatch bias in exp(new−old) ratios (ADVICE r3).
+    `NANORLHF_FLASH_BWD=xla` is not consulted here (chunk backwards need
+    the global-lse form only the Pallas kernels expose); use
+    `attn_impl="xla"` to route the whole ring to the einsum path instead.
+    """
+    return _ring_flash_core(q, k, v, key_valid, axis_name, causal,
+                            block_q, block_k)

@@ -37,10 +37,11 @@ from nanorlhf_tpu.parallel.ring_attention import (
 
 
 def _ring_attn_fn(key_valid, axis_name, attn_impl: str, t_local: int):
-    """Pick the ring implementation: the Pallas flash ring (forward-only,
-    `ring_attention_flash`) when `attn_impl` resolves to flash at this local
-    width, the differentiable einsum ring otherwise. Callers that
-    differentiate MUST stay on "xla"."""
+    """Pick the ring implementation: the Pallas flash ring
+    (`ring_attention_flash`, differentiable via its global-lse custom_vjp)
+    when `attn_impl` resolves to flash at this local width, the einsum ring
+    otherwise. Scoring and update callers should pass the SAME attn_impl so
+    exp(new−old) ratios carry no kernel-mismatch offset (ADVICE r3)."""
     if use_flash(attn_impl, t_local):
         return lambda q, k, v: ring_attention_flash(
             q, k, v, key_valid, axis_name=axis_name, causal=True
@@ -220,10 +221,12 @@ def sp_score_logprobs(
     — pass the trainer's gradient_checkpointing when differentiating through
     this (scoring-only callers can leave it off).
 
-    `attn_impl` routes the ring: "auto"/"pallas" engage the forward-only
-    flash ring (`ring_attention_flash`) per `use_flash` resolution —
-    SCORING-ONLY; callers that differentiate (the update path) must keep
-    the default "xla" einsum ring, which has a backward.
+    `attn_impl` routes the ring: "auto"/"pallas" engage the flash ring
+    (`ring_attention_flash`) per `use_flash` resolution. Both rings are
+    differentiable (the flash ring's backward re-runs the ring through the
+    Pallas flash-bwd kernels with the global lse) — scoring and update
+    passes should use the SAME impl so the ratio/KL estimates carry no
+    kernel-mismatch offset.
 
     `with_entropy=True` additionally returns the unmasked-mean entropy of
     the temperature-scaled logits (the reference's `policy/entropy_avg_new`
@@ -324,9 +327,9 @@ def sp_score_values(
     parallelism — `core.model.score_forward` at ring scale (the PPO value
     pass, `PPO/ppo_trainer.py:630-634,732`, for beyond-one-device contexts).
     The score head is position-local, so unlike logprob scoring nothing
-    crosses shard boundaries after the ring. Differentiable with the
-    default "xla" ring (the PPO update needs the value gradient); flash is
-    scoring-only."""
+    crosses shard boundaries after the ring. Differentiable with either
+    ring impl; the PPO update should score and differentiate with the same
+    `attn_impl` as the value-scoring pass."""
     from nanorlhf_tpu.core.model import padding_inputs, rms_norm
 
     _, attention_mask, position_ids = padding_inputs(query_responses, pad_token_id)

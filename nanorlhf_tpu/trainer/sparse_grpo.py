@@ -172,7 +172,7 @@ class SparseGRPOTrainer(RLTrainer):
 
         @partial(jax.jit, static_argnums=(3,))
         def score(params, ref_params, qr, context_length: int):
-            # scoring never differentiates → the flash ring is legal
+            # same attn_impl as `_sp_grad_fn`'s update forward (ADVICE r3)
             lp = sp_score_logprobs(
                 params, mcfg, qr, pad_id, cfg.temperature, mesh,
                 fsdp_axis=fsdp_axis, lora_scale=lora_scale,
@@ -200,11 +200,15 @@ class SparseGRPOTrainer(RLTrainer):
 
         def loss_fn(trainable, frozen, mb, context_length, loss_scale):
             tree = combine(trainable, frozen)
+            # attn_impl matches `_sp_score_fn` (the flash ring has a
+            # backward): old/ref and new logprobs share kernels, so the
+            # exp(new−old) ratio has no kernel-mismatch offset (ADVICE r3)
             new_lp, entropy = sp_score_logprobs(
                 tree["policy"], mcfg, mb["query_responses"], pad_id,
                 cfg.temperature, mesh, fsdp_axis=fsdp_axis,
                 lora_scale=lora_scale, remat=cfg.gradient_checkpointing,
                 with_entropy=True, entropy_from_position=context_length - 1,
+                attn_impl=mcfg.attention_impl,
             )
             new_lp = new_lp[:, context_length - 1 : -1]
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)

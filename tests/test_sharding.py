@@ -125,3 +125,61 @@ def test_rules_shard_large_geometries_evenly():
                     f"{cfg.hidden_size=} {path} dim {dim} "
                     f"({leaf.shape[dim]}) not divisible by {axes} ({n})"
                 )
+
+
+def test_update_minibatch_no_involuntary_remat(tmp_path, capfd):
+    """The [mini] -> [micro, grad_accum] stack keeps the SHARDED row dim
+    major and constrains it ONCE outside the scan, so GSPMD reaches the
+    per-microbatch sharding without the "Involuntary full
+    rematerialization" fallback (replicate-then-repartition of a minibatch
+    tensor EVERY optimizer step — VERDICT r3 #2, visible in the
+    MULTICHIP_r03 dryrun tail). The warning reproduces on the dryrun's SP
+    dense-GRPO phase — mesh (data=4, sp=2) — where the scan-body
+    constraint's dim-0 data sharding collides with the SP shard_map's
+    dim-1 sequence sharding (mutation-verified: reverting the trainer
+    layout makes this test fail). Compile must stay fallback-free."""
+    import zlib
+
+    from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+    from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
+
+    tok = ToyTokenizer(256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    dataset = load_prompt_dataset("synthetic:64", tok, max_prompt_len=12)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / "remat"),
+        response_length=8,
+        temperature=1.0,
+        sample_n=2,
+        per_device_train_batch_size=4,
+        gradient_accumulation_steps=1,
+        num_mini_batches=1,
+        total_episodes=16,  # one update: pd(4) x data(4)
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=True,
+        save_steps=0,
+        report_to="none",
+    )
+    mesh = make_mesh(MeshConfig(4, 1, 1, 2), devices=jax.devices())
+
+    def reward(pmt_and_responses, eos_token):
+        return np.asarray(
+            [(zlib.crc32(s.encode()) % 17) / 17.0 for s in pmt_and_responses],
+            np.float32,
+        )
+
+    # the persistent compile cache (conftest) can serve the update
+    # executable without compiling — and the warning only fires DURING
+    # compilation, which would make this assertion vacuous. Force fresh
+    # compiles for this test only.
+    saved = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        trainer = RLTrainer(cfg, mcfg, tok, params, dataset, reward, mesh=mesh)
+        trainer.train(num_updates=1)
+    finally:
+        jax.config.update("jax_enable_compilation_cache", saved)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]

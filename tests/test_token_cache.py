@@ -78,3 +78,70 @@ def test_load_prompt_dataset_cache_hit(tmp_path, monkeypatch):
     with pytest.raises(AssertionError):
         load_prompt_dataset("synthetic:24", tok, max_prompt_len=32, seed=4,
                             cache_dir=str(tmp_path))
+
+
+@pytest.mark.skipif(not native.available(), reason="no native toolchain")
+def test_native_rejects_huge_n_rows_header(tmp_path):
+    """A corrupt header whose u64 n_rows exceeds what the file can hold must
+    be rejected BEFORE any seek-offset arithmetic — the unchecked value can
+    overflow the signed fseek offset (UB) and the expected-size computation
+    (ADVICE r3). The Python fallback already rejects via ValueError."""
+    import struct
+
+    path = str(tmp_path / "c.tok")
+    assert native.token_cache_write_native(path, ROWS, FP)
+    raw = bytearray(open(path, "rb").read())
+    good = bytes(raw)
+    for bogus in (2**63 // 8, 2**64 - 1, len(raw)):  # overflow + oversize
+        raw[8:16] = struct.pack("<Q", bogus)
+        open(path, "wb").write(raw)
+        assert native.token_cache_open_native(path, FP) is None
+        assert _read_py(path, FP) is None
+    # corrupt LAST OFFSET near 2^62: (2^62+total)*4 wraps mod 2^64 back onto
+    # the true payload size, so an unbounded reader computes expect ==
+    # st_size and returns total_tokens ~ 2^62 (code-review r4 finding) —
+    # both readers must reject via the payload-capacity bound
+    raw = bytearray(good)
+    n = len(ROWS)
+    total = sum(len(r) for r in ROWS)
+    last_off_at = 24 + n * 8
+    raw[last_off_at:last_off_at + 8] = struct.pack("<q", 2**62 + total)
+    open(path, "wb").write(raw)
+    assert native.token_cache_open_native(path, FP) is None
+    assert _read_py(path, FP) is None
+
+
+def test_load_prompt_dataset_cache_content_sensitive(tmp_path, monkeypatch):
+    """Same (name, split, limit, seed, tokenizer) but DIFFERENT corpus
+    content must miss the cache and re-tokenize — for HF sources the
+    fingerprint hashes the raw texts, so an upstream dataset revision
+    change cannot silently serve stale tokens (ADVICE r3). `synthetic:`
+    corpora stay params-keyed: their content is fully determined by
+    (name, seed, tokenizer identity), so they keep the load-free hit."""
+    tok = ToyTokenizer(vocab_size=512)
+    kw = dict(max_prompt_len=32, seed=3, cache_dir=str(tmp_path))
+
+    def corpus(tag):
+        return [{"chosen": f"\n\nHuman: {tag} question {i}\n\nAssistant: ok"}
+                for i in range(8)]
+
+    monkeypatch.setattr(datasets_mod, "_load_hf_dataset",
+                        lambda name, split: corpus("v1"))
+    load_prompt_dataset("fake/hh", tok, **kw)
+
+    calls = []
+    real_encode = datasets_mod.encode_texts
+
+    def counting_encode(*a, **k):
+        calls.append(1)
+        return real_encode(*a, **k)
+
+    monkeypatch.setattr(datasets_mod, "encode_texts", counting_encode)
+    # identical request + identical content -> cache hit, no tokenization
+    load_prompt_dataset("fake/hh", tok, **kw)
+    assert not calls
+    # same request args, different underlying corpus -> must re-tokenize
+    monkeypatch.setattr(datasets_mod, "_load_hf_dataset",
+                        lambda name, split: corpus("v2"))
+    load_prompt_dataset("fake/hh", tok, **kw)
+    assert calls
