@@ -301,6 +301,7 @@ class SparseGRPOTrainer(RLTrainer):
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,
             compaction_segments=cfg.rollout_compaction_segments,
+            top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])

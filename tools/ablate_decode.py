@@ -4,8 +4,9 @@ Measures rollout (generation) throughput of the flagship-shaped policy under
 each decode lever shipped in r2, at short and long response lengths. The
 levers (see docs/ROADMAP.md #2):
 
-  exact_topk    — lax.top_k nucleus (full-vocab sort on TPU; r1 behavior)
+  exact_topk    — lax.top_k k=64 pre-trim (full-vocab sort on TPU)
   approx_topk   — lax.approx_max_k pre-trim (default since r2)
+  full_nucleus  — top_k=0 exact full-vocab nucleus (r1-zero default, r4)
   int8_weights  — rollout_quant="int8" weight-only base projections
   int8_kv       — kv_cache_quant="int8" + q8 decode kernel
   int8_both     — both quantizations
@@ -77,6 +78,11 @@ def main():
         levers = {
             "exact_topk": dict(base, sp_kw={"approx_top_k": False}),
             "approx_topk": dict(base),
+            # top_k=0: exact full-vocab nucleus (full sort) — the r1-zero
+            # launcher default since r4 (base-model exploration must not be
+            # top-k-truncated); its cost vs the k=64 pre-trim decides
+            # whether other launchers follow
+            "full_nucleus": dict(base, sp_kw={"top_k": 0}),
             "int8_weights": None,  # filled below (lazy quantize)
             "int8_kv": dict(base, mcfg=kv_cfg),
             "int8_both": None,

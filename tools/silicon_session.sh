@@ -1,0 +1,52 @@
+#!/bin/bash
+# One-command silicon session (VERDICT r3 #1): run the moment the axon
+# tunnel is up. Each step is ONE jax process (single TPU claim); steps run
+# sequentially with a socket preflight in between so a dead relay skips
+# cleanly instead of hanging a claim. Outputs land in /tmp/silicon_r4/.
+#
+#   bash tools/silicon_session.sh            # full session
+#   STEPS=bench bash tools/silicon_session.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/silicon_r4
+mkdir -p "$OUT"
+STEPS="${STEPS:-ablate bench learn drift}"
+
+alive() {
+  python3 - <<'EOF'
+import socket, sys
+for port in (8082, 8092, 8102, 8112):
+    s = socket.socket(); s.settimeout(3)
+    try:
+        s.connect(("127.0.0.1", port)); sys.exit(0)
+    except OSError:
+        pass
+    finally:
+        s.close()
+sys.exit(1)
+EOF
+}
+
+run_step() {  # name, timeout_s, command...
+  local name=$1 tmo=$2; shift 2
+  if ! alive; then
+    echo "[$name] tunnel DOWN — skipping" | tee -a "$OUT/session.log"
+    return 1
+  fi
+  echo "[$name] start $(date +%H:%M:%S)" | tee -a "$OUT/session.log"
+  timeout "$tmo" "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "[$name] rc=$rc $(date +%H:%M:%S)" | tee -a "$OUT/session.log"
+  tail -3 "$OUT/$name.log"
+  return $rc
+}
+
+for s in $STEPS; do
+  case $s in
+    ablate) run_step ablate 2400 python tools/ablate_decode.py ;;
+    bench)  run_step bench 4800 env BENCH_ATTEMPT_TIMEOUT=4300 python bench.py ;;
+    learn)  run_step learn 3600 env LEARN_UPDATES=30 python tools/learning_run.py ;;
+    drift)  run_step drift 1800 python tools/capture_drift.py ;;
+  esac
+done
+echo "session done; logs in $OUT"
