@@ -47,7 +47,10 @@ def main(run_value_init: bool = True, value_init_cfg: ValueInitConfig | None = N
         vcfg = value_init_cfg or ValueInitConfig()
         prompts = np.asarray(dataset.input_ids[: vcfg.train_data_size])
         trainer.value_params = finetune_value_model(
-            trainer.value_params, trainer.params, trainer.ref_params,
+            trainer.value_params, trainer.params,
+            # None in ref-free mode (kl_coef 0): value_init then skips the
+            # ref forward — its KL shaping is multiplied away anyway
+            trainer.ref_params,
             reward_func, prompts, trainer.tokenizer, trainer.mcfg,
             response_length=cfg.response_length, temperature=cfg.temperature,
             kl_coef=cfg.kl_coef, gamma=cfg.gamma, vcfg=vcfg,

@@ -81,8 +81,8 @@ class SamplingParams:
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
     """Mask logits outside the top-p nucleus (smallest set with cum prob ≥ p).
 
-    Full-vocab exact variant — kept as the reference/oracle for the fused
-    top-k path used in the decode loop.
+    Sort-based exact variant — the reference/oracle for the sort-free
+    bisection filter below and for the fused top-k path in the decode loop.
     """
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
@@ -94,6 +94,42 @@ def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
     return jnp.where(logits >= threshold, logits, -jnp.inf)
+
+
+def top_p_filter_bisect(logits: jnp.ndarray, top_p: float,
+                        iters: int = 26) -> jnp.ndarray:
+    """Exact nucleus filter WITHOUT the full-vocab sort.
+
+    XLA lowers `jnp.sort` over an LLM vocabulary to a slow multi-pass sort
+    on TPU (the r2-measured decode hot spot), but the nucleus mask is a
+    pure THRESHOLD set: sorted-descending, keep-while-exclusive-cum < p is
+    exactly {i : p_i >= tau} where tau is the smallest probability in the
+    minimal prefix reaching mass p (the sort-based filter keeps threshold
+    ties the same way, `logits >= threshold`). The keep-set mass is a
+    decreasing step function of tau, so tau comes from bisection over
+    (0, p_max]: `iters` reduction passes over [B, V] (VPU-friendly
+    elementwise+sum, no data movement) instead of a sort. 26 iterations
+    drive the bracket below f32 resolution of p_max (2^-24), so any
+    difference vs the oracle sits inside a float tie the sort itself
+    cannot order stably either. Used by `_sample_token` for the
+    `top_k=0` exact-nucleus path (the r1-zero launcher default).
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    p_max = jnp.max(probs, axis=-1, keepdims=True)
+
+    def step(carry, _):
+        lo, hi = carry                       # mass(lo) >= top_p > mass(hi)
+        mid = 0.5 * (lo + hi)
+        mass = jnp.sum(jnp.where(probs >= mid, probs, 0.0), axis=-1,
+                       keepdims=True)
+        ok = mass >= top_p
+        return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)), None
+
+    # lo=0 keeps everything (mass 1 >= p); hi just above p_max keeps nothing
+    (lo, _), _ = jax.lax.scan(
+        step, (jnp.zeros_like(p_max), p_max * (1 + 1e-6)), None, length=iters
+    )
+    return jnp.where(probs >= lo, logits, -jnp.inf)
 
 
 def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
@@ -115,7 +151,8 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_p >= 1.0 or top_k <= 0:
         if top_p < 1.0:
-            logits = top_p_filter(logits, top_p)   # exact full-vocab nucleus
+            # exact full-vocab nucleus, sort-free (bisection threshold)
+            logits = top_p_filter_bisect(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
     k = min(top_k, logits.shape[-1])
     if approx_top_k and k < logits.shape[-1]:

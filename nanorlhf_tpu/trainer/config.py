@@ -109,6 +109,14 @@ class RLConfig:
 
     # ---- RL coefficients ----
     kl_coef: float = 0.01
+    # With kl_coef == 0 the reference's r1-zero path runs NO reference model
+    # at all (`examples/r1-v0/grpo_r1.py:138` — no ref load, no ref pass);
+    # matching that skips the ref weight copy (3 GB HBM at 1.5B) and the ref
+    # half of every scoring pass — combined with sampler_logprob_capture the
+    # scoring forwards disappear entirely. None = auto (ref-free iff
+    # kl_coef == 0); True forces ref scoring anyway (e.g. to monitor KL
+    # drift at coef 0). KL metrics read 0 in ref-free mode.
+    score_ref_logprobs: Optional[bool] = None
     cliprange: float = 0.2
     cliprange_value: float = 0.01
     vf_coef: float = 0.1

@@ -294,9 +294,14 @@ class SparseGRPOTrainer(RLTrainer):
             acc = float(self.accuracy_func(self))
             self.logger.log(0, 0, {"initial_accuracy": acc})
 
-        # _ref_score_fn itself branches to the SP scorer when sp is on
+        # the single-model scorer branches to the SP variant when sp is on;
+        # ref-free mode scores the POLICY with it (adapters applied via
+        # _policy_score_fn), capture mode scores the ref
         capture = cfg.sampler_logprob_capture
-        ref_fn = self._ref_score_fn() if capture else None
+        if self._ref_free:
+            ref_fn = None if capture else self._policy_score_fn()
+        else:
+            ref_fn = self._ref_score_fn() if capture else None
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,
@@ -405,7 +410,10 @@ class SparseGRPOTrainer(RLTrainer):
                 # policy logprobs came from the sampler; buckets below only
                 # run the ref forward (half the scoring work)
                 logprobs = captured_lp[:, :max_resp].astype(np.float32)
-            for idxs in buckets:
+            ref_free = self._ref_free
+            for idxs in ([] if (ref_free and capture) else buckets):
+                # ref-free + capture: zero scoring forwards (sampler-captured
+                # policy logprobs, no reference model — the r1 setting)
                 blen = round_up_to_menu(int(qr_len[idxs].max()), self._len_menu)
                 blen = min(max(blen, context_length + 1), qr.shape[1])
                 blen = self._sp_round_len(blen, qr.shape[1])
@@ -414,7 +422,11 @@ class SparseGRPOTrainer(RLTrainer):
                     {"qr": qr[idxs][:, :blen]}, rows_b, {"qr": pad_id}
                 )
                 width = blen - context_length
-                if capture:
+                if ref_free:
+                    lp = ref_fn(self.params, jnp.asarray(padded["qr"]),
+                                context_length)
+                    logprobs[idxs, :width] = np.asarray(lp)[: len(idxs)]
+                elif capture:
                     rlp = ref_fn(self.ref_params, jnp.asarray(padded["qr"]),
                                  context_length)
                     ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
@@ -425,6 +437,9 @@ class SparseGRPOTrainer(RLTrainer):
                     )
                     logprobs[idxs, :width] = np.asarray(lp)[: len(idxs)]
                     ref_logprobs[idxs, :width] = np.asarray(rlp)[: len(idxs)]
+            if ref_free:
+                # ref == policy-old: every KL term and metric reads exactly 0
+                ref_logprobs = logprobs.copy()
 
             # ---- masks + advantages ---------------------------------------
             seq_len = np.asarray(first_true_indices(jnp.asarray(post) == pad_id) - 1)
@@ -502,8 +517,13 @@ class SparseGRPOTrainer(RLTrainer):
                 np.where(padding_mask, 0.0, logprobs - ref_logprobs).sum(1).mean()
             )
             metrics = {
-                # GRPO parity: update-pass refkl (see docs/METRICS.md)
-                "objective/kl_old": agg.get("refkl_mean", kl_rollout),
+                # GRPO parity: update-pass refkl (see docs/METRICS.md);
+                # 0 in ref-free mode — the stand-in refkl would report
+                # KL-to-old-policy, not a reference KL
+                "objective/kl_old": (
+                    0.0 if self._ref_free
+                    else agg.get("refkl_mean", kl_rollout)
+                ),
                 "objective/kl_rollout_old": kl_rollout,
                 "objective/non_score_reward_old": 0.0,  # GRPO: KL is in-loss
                 "eval_objective/rlhf_reward_old": mean_raw_score,

@@ -237,8 +237,26 @@ class RLTrainer:
         # Copy-on-intake: device_put with an unchanged sharding ALIASES the
         # caller's buffers, and the jitted update donates its inputs — without
         # the copy, training would invalidate the arrays the caller passed in.
-        ref = {k: v for k, v in params.items() if k != "lora"}
-        self.ref_params = shard_params(jax.tree.map(jnp.copy, ref), self.mesh)
+        # Ref-free mode (kl_coef == 0, r1-zero parity): no copy, no ref pass.
+        if config.score_ref_logprobs is False and config.kl_coef != 0.0:
+            # dropping the ref while its KL coefficient is live would
+            # silently swap the configured ref-KL objective for a
+            # KL-to-old-policy (GRPO) or a zeroed penalty (KL-in-reward)
+            raise ValueError(
+                "score_ref_logprobs=False requires kl_coef == 0 — with a "
+                "live KL coefficient the reference logprobs are part of "
+                "the objective, not just a metric"
+            )
+        self._ref_free = not (
+            config.score_ref_logprobs
+            if config.score_ref_logprobs is not None
+            else config.kl_coef != 0.0
+        )
+        if self._ref_free:
+            self.ref_params = None
+        else:
+            ref = {k: v for k, v in params.items() if k != "lora"}
+            self.ref_params = shard_params(jax.tree.map(jnp.copy, ref), self.mesh)
         self.params = shard_params(jax.tree.map(jnp.copy, params), self.mesh)
         self.value_params = (
             shard_params(jax.tree.map(jnp.copy, value_params), self.mesh)
@@ -653,11 +671,16 @@ class RLTrainer:
         self._score_fn_cached = score
         return score
 
-    def _ref_score_fn(self):
-        """Ref-policy-only scorer — the sampler-logprob-capture path skips
-        the policy forward entirely."""
-        if hasattr(self, "_ref_score_cached"):
-            return self._ref_score_cached
+    def _single_score_fn(self, lora_scale: float = 1.0):
+        """Single-model logprob scorer (jitted, cached per lora_scale) —
+        scores whatever param tree it is handed. lora_scale=1.0 suits the
+        (adapter-free) ref tree; pass self.lora_scale to score the POLICY
+        tree, whose adapters must be applied (the ref-free path)."""
+        cache = getattr(self, "_single_score_cache", None)
+        if cache is None:
+            cache = self._single_score_cache = {}
+        if lora_scale in cache:
+            return cache[lora_scale]
         mcfg, cfg = self.mcfg, self.cfg
         pad_id = self.tokenizer.pad_token_id
 
@@ -669,26 +692,35 @@ class RLTrainer:
             mesh, fsdp_axis = self.mesh, self._fsdp_axis()
 
             @partial(jax.jit, static_argnums=(2,))
-            def score_ref(ref_params, query_responses, context_length: int):
+            def score_one(tree, query_responses, context_length: int):
                 return sp_score_logprobs(
-                    ref_params, mcfg, query_responses, pad_id, cfg.temperature,
-                    mesh, fsdp_axis=fsdp_axis, attn_impl=mcfg.attention_impl,
+                    tree, mcfg, query_responses, pad_id, cfg.temperature,
+                    mesh, fsdp_axis=fsdp_axis, lora_scale=lora_scale,
+                    attn_impl=mcfg.attention_impl,
                 )[:, context_length - 1 : -1]
+        else:
+            @partial(jax.jit, static_argnums=(2,))
+            def score_one(tree, query_responses, context_length: int):
+                responses = query_responses[:, context_length:]
+                logits = padded_forward_logits(
+                    tree, mcfg, query_responses, pad_id,
+                    lora_scale=lora_scale,
+                    response_context_length=context_length,
+                )
+                return logprobs_from_logits(logits, responses, cfg.temperature)
 
-            self._ref_score_cached = score_ref
-            return score_ref
+        cache[lora_scale] = score_one
+        return score_one
 
-        @partial(jax.jit, static_argnums=(2,))
-        def score_ref(ref_params, query_responses, context_length: int):
-            responses = query_responses[:, context_length:]
-            ref_logits = padded_forward_logits(
-                ref_params, mcfg, query_responses, pad_id,
-                response_context_length=context_length,
-            )
-            return logprobs_from_logits(ref_logits, responses, cfg.temperature)
+    def _ref_score_fn(self):
+        """Ref-policy-only scorer — the sampler-logprob-capture path skips
+        the policy forward entirely."""
+        return self._single_score_fn(1.0)
 
-        self._ref_score_cached = score_ref
-        return score_ref
+    def _policy_score_fn(self):
+        """Policy-only scorer (adapters applied) — the ref-free path's
+        replacement for the two-model chunk scorer."""
+        return self._single_score_fn(self.lora_scale)
 
     # ------------------------------------------------------------------ #
     # the training loop
@@ -836,26 +868,47 @@ class RLTrainer:
             )
             chunk = max(1, min(total, chunk))
             logprobs_l, ref_logprobs_l = [], []
-            ref_fn = self._ref_score_fn() if capture else None
+            ref_free = self._ref_free
+            if ref_free:
+                # policy-only scorer (adapters applied); None when capture
+                # also supplies the policy side — nothing left to score
+                one_fn = None if capture else self._policy_score_fn()
+            else:
+                one_fn = self._ref_score_fn() if capture else None
             with self.timer.phase("logprob"):
-                for i in range(0, total, chunk):
-                    n_real = min(chunk, total - i)
-                    rows_c = jnp.asarray(pad_chunk(qr[i : i + chunk], chunk))
-                    if capture:
-                        # policy logprobs came from the sampler; only the
-                        # ref pass runs — half the scoring forwards
-                        rlp = ref_fn(self.ref_params, rows_c, context_length)
-                        ref_logprobs_l.append(np.asarray(rlp)[:n_real])
-                    else:
-                        lp, rlp = score_fn(
-                            self.params, self.ref_params, rows_c, context_length,
-                        )
-                        logprobs_l.append(np.asarray(lp)[:n_real])
-                        ref_logprobs_l.append(np.asarray(rlp)[:n_real])
+                if ref_free and capture:
+                    # zero scoring forwards: policy logprobs came from the
+                    # sampler, and there is no reference model (kl_coef 0 —
+                    # the reference's r1 path, `grpo_r1.py:138`)
+                    pass
+                else:
+                    for i in range(0, total, chunk):
+                        n_real = min(chunk, total - i)
+                        rows_c = jnp.asarray(pad_chunk(qr[i : i + chunk], chunk))
+                        if ref_free:
+                            # policy-only forward (adapters applied)
+                            lp = one_fn(self.params, rows_c, context_length)
+                            logprobs_l.append(np.asarray(lp)[:n_real])
+                        elif capture:
+                            # policy logprobs came from the sampler; only the
+                            # ref pass runs — half the scoring forwards
+                            rlp = one_fn(self.ref_params, rows_c, context_length)
+                            ref_logprobs_l.append(np.asarray(rlp)[:n_real])
+                        else:
+                            lp, rlp = score_fn(
+                                self.params, self.ref_params, rows_c,
+                                context_length,
+                            )
+                            logprobs_l.append(np.asarray(lp)[:n_real])
+                            ref_logprobs_l.append(np.asarray(rlp)[:n_real])
             logprobs = (
                 captured_lp if capture else np.concatenate(logprobs_l)
             ).astype(np.float32)
-            ref_logprobs = np.concatenate(ref_logprobs_l)
+            # ref == policy-old in ref-free mode: every KL term and metric
+            # reads exactly 0, matching "no reference model"
+            ref_logprobs = (
+                logprobs.copy() if ref_free else np.concatenate(ref_logprobs_l)
+            )
 
             # ---- response post-processing ---------------------------------
             responses_j = jnp.asarray(responses_np)
@@ -953,6 +1006,11 @@ class RLTrainer:
                 agg.get("refkl_mean", kl_rollout)
                 if self.algo == AlgoName.GRPO else kl_rollout
             )
+            if self._ref_free:
+                # no reference model exists: GRPO's update-pass refkl stat
+                # would otherwise report KL-to-OLD-POLICY here (ref stands
+                # in as the old logprobs), which is not the metric's meaning
+                kl_old = 0.0
             metrics = {
                 "objective/kl_old": kl_old,
                 "objective/kl_rollout_old": kl_rollout,

@@ -54,7 +54,7 @@ class ValueInitConfig:
 def finetune_value_model(
     value_params: dict,
     policy_params: dict,
-    ref_params: dict,
+    ref_params: dict | None,
     reward_func,
     prompts: np.ndarray,          # [N, Tp] left-padded prompt ids
     tokenizer,
@@ -107,8 +107,13 @@ def finetune_value_model(
     # ---- logprob pass → KL-shaped rewards → returns ------------------------
     qr = np.concatenate([prompts, responses_np], axis=1)
 
-    @partial(jax.jit, static_argnums=(3,))
-    def lp_fn(p, rp, ids, ctx):
+    # ref_params=None (ref-free mode, kl_coef 0): skip the ref forward
+    # entirely — the KL shaping it would feed is multiplied away, and a
+    # stand-in policy forward would just double the pass for a zero term
+    ref_free = ref_params is None
+
+    @partial(jax.jit, static_argnums=(3, 4))
+    def lp_fn(p, rp, ids, ctx, with_ref: bool):
         resp = ids[:, ctx:]
         lp = logprobs_from_logits(
             padded_forward_logits(p, model_config, ids, pad_id,
@@ -116,6 +121,8 @@ def finetune_value_model(
                                   response_context_length=ctx),
             resp, temperature,
         )
+        if not with_ref:
+            return lp, lp
         rlp = logprobs_from_logits(
             padded_forward_logits(rp, model_config, ids, pad_id,
                                   response_context_length=ctx),
@@ -126,8 +133,11 @@ def finetune_value_model(
     chunk = max(1, 28 * 2316 // qr.shape[1])
     lps, rlps = [], []
     for i in range(0, qr.shape[0], chunk):
-        lp, rlp = lp_fn(policy_params, ref_params, jnp.asarray(qr[i : i + chunk]),
-                        context_length)
+        lp, rlp = lp_fn(
+            policy_params,
+            policy_params if ref_free else ref_params,
+            jnp.asarray(qr[i : i + chunk]), context_length, not ref_free,
+        )
         lps.append(np.asarray(lp))
         rlps.append(np.asarray(rlp))
     logprobs, ref_logprobs = np.concatenate(lps), np.concatenate(rlps)

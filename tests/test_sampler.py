@@ -214,3 +214,44 @@ def test_capture_logprobs_match_scoring_pass(tiny):
             assert abs(lp[b, t] - scored[b, t]) < 1e-3, (b, t, lp[b, t], scored[b, t])
             if out[b, t] == EOS:
                 break
+
+
+def test_top_p_bisect_matches_sort_oracle(rng):
+    """The sort-free bisection nucleus filter must produce the SAME keep
+    mask as the sort-based oracle — peaked, flat, bf16-quantized (mass
+    ties), and near-one-hot distributions. The decode loop's top_k=0 path
+    (the r1-zero launcher default) rides the bisection variant."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.sampler.sampler import top_p_filter, top_p_filter_bisect
+
+    cases = [
+        rng.normal(size=(4, 512)).astype(np.float32),            # generic
+        (rng.normal(size=(2, 512)) * 8).astype(np.float32),      # peaked
+        np.zeros((1, 512), np.float32),                          # exact flat
+        jnp.asarray(rng.normal(size=(2, 512)), jnp.bfloat16)     # bf16 ties
+            .astype(jnp.float32),
+    ]
+    onehot = np.full((1, 512), -30.0, np.float32); onehot[0, 7] = 10.0
+    cases.append(onehot)
+    for i, logits in enumerate(cases):
+        logits = jnp.asarray(logits)
+        for p in (0.5, 0.9, 0.95, 0.99):
+            want = np.asarray(top_p_filter(logits, p)) > -np.inf
+            got = np.asarray(top_p_filter_bisect(logits, p)) > -np.inf
+            # identical masks except possibly inside an exact float tie at
+            # the boundary (the sort cannot order ties stably either):
+            # every disagreement must sit at exactly the threshold prob
+            if not np.array_equal(want, got):
+                probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+                for b in range(logits.shape[0]):
+                    dis = want[b] != got[b]
+                    if dis.any():
+                        kept = probs[b][want[b]]
+                        assert np.allclose(
+                            probs[b][dis], kept.min(), rtol=1e-6
+                        ), f"case {i} p={p}: non-tie disagreement"
+            # the kept mass must reach p either way (nucleus property)
+            probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+            for b in range(logits.shape[0]):
+                assert probs[b][got[b]].sum() >= p - 1e-5

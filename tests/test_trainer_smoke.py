@@ -291,3 +291,71 @@ def test_rollout_top_k_reaches_sampler(tmp_path, monkeypatch):
     from nanorlhf_tpu.entrypoints.grpo_r1 import build_config
 
     assert build_config().rollout_top_k == 0
+
+
+def test_ref_free_mode_kl0(tmp_path):
+    """kl_coef == 0 auto-drops the reference model (r1-zero parity — the
+    reference loads NO ref model on that path, `grpo_r1.py:138`): no ref
+    weight copy, no ref half of the scoring pass, and the training
+    trajectory is BIT-IDENTICAL to a forced-ref run, because ref logprobs
+    only ever enter terms multiplied by kl_coef. score_ref_logprobs=True
+    forces ref scoring (e.g. to monitor KL drift at coef 0)."""
+    t_free = make_trainer(AlgoName.GRPO, tmp_path, kl_coef=0.0,
+                          output_dir=str(tmp_path / "free"))
+    assert t_free.ref_params is None        # no 2nd weight copy in HBM
+    t_free.train(num_updates=2)
+
+    t_full = make_trainer(AlgoName.GRPO, tmp_path, kl_coef=0.0,
+                          score_ref_logprobs=True,
+                          output_dir=str(tmp_path / "full"))
+    assert t_full.ref_params is not None
+    t_full.train(num_updates=2)
+
+    for a, b in zip(jax.tree.leaves(t_free.params),
+                    jax.tree.leaves(t_full.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # KL metrics read 0 (no reference model exists; the GRPO update-pass
+    # refkl stand-in would otherwise report KL-to-old-policy)
+    import json
+
+    rows = [json.loads(l) for l in open(tmp_path / "free" / "metrics.jsonl")
+            if "objective/kl_old" in l]
+    assert rows and all(r["objective/kl_old"] == 0.0 for r in rows)
+    assert all(r["objective/kl_rollout_old"] == 0.0 for r in rows)
+
+    # capture + ref-free: the scoring pass disappears entirely — still runs
+    t_cap = make_trainer(AlgoName.GRPO, tmp_path, kl_coef=0.0,
+                         sampler_logprob_capture=True,
+                         output_dir=str(tmp_path / "cap"))
+    t_cap.train(num_updates=1)
+
+    # dropping the ref while its KL coefficient is live is rejected — it
+    # would silently swap the configured objective
+    with pytest.raises(ValueError, match="score_ref_logprobs"):
+        make_trainer(AlgoName.GRPO, tmp_path, kl_coef=0.01,
+                     score_ref_logprobs=False,
+                     output_dir=str(tmp_path / "bad"))
+
+    # PPO value-init with a None ref (ref-free): the ref forward is skipped
+    # and the returned tree still regresses
+    from nanorlhf_tpu.core import init_score_head
+    from nanorlhf_tpu.trainer.value_init import (
+        ValueInitConfig, finetune_value_model)
+
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    pol = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    val = init_params(mcfg, jax.random.PRNGKey(1), jnp.float32)
+    val.pop("lm_head", None)
+    val["score"] = init_score_head(mcfg, jax.random.PRNGKey(2),
+                                   dtype=jnp.float32)
+    prompts = load_prompt_dataset("synthetic:8", tok,
+                                  max_prompt_len=8).input_ids
+    out = finetune_value_model(
+        val, pol, None, rule_reward, np.asarray(prompts), tok, mcfg,
+        response_length=8, temperature=1.0, kl_coef=0.0, gamma=1.0,
+        vcfg=ValueInitConfig(train_data_size=8, num_train_epochs=1,
+                             per_device_train_batch_size=4),
+    )
+    assert "score" in out
