@@ -155,13 +155,17 @@ def main():
         sample_n=4,
         kl_coef=0.0,                     # r1: no KL (`grpo_r1.py:138`)
         learning_rate=float(os.environ.get("LEARN_LR", 1e-2)),
-        per_device_train_batch_size=prompts,
+        # LEARN_PROMPTS is the GLOBAL prompts-per-update; the mesh takes
+        # every visible device on its data axis (1 on the single-chip
+        # tunnel, 8 on the virtual CPU test mesh)
+        per_device_train_batch_size=max(1, prompts // len(jax.devices())),
         gradient_accumulation_steps=1,
         num_mini_batches=1,
-        total_episodes=updates * prompts * 4,
+        total_episodes=updates
+        * max(1, prompts // len(jax.devices())) * len(jax.devices()) * 4,
         use_lora=False,                  # full FT: random init has no base
         gradient_checkpointing=True,
-        mesh=MeshConfig(1, 1, 1),
+        mesh=MeshConfig(-1, 1, 1),
         save_steps=0,
         report_to="jsonl",
         logging_steps=1,
