@@ -24,9 +24,14 @@ def det_reward(pmt_and_responses, eos_token):
     )
 
 
-def _make_trainer(tmp_path, name, mesh, algo=AlgoName.GRPO, **cfg_kw):
+def _make_trainer(tmp_path, name, mesh, algo=AlgoName.GRPO, mcfg_replace=None,
+                  **cfg_kw):
+    import dataclasses
+
     tok = ToyTokenizer(512)
     mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    if mcfg_replace:
+        mcfg = dataclasses.replace(mcfg, **mcfg_replace)
     params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
     dataset = load_prompt_dataset("synthetic:32", tok, max_prompt_len=16)
     defaults = dict(
@@ -195,3 +200,30 @@ def test_sp_width_divisibility_enforced(tmp_path):
     )
     with pytest.raises(ValueError, match="divisible by sp"):
         tr.train(num_updates=1)
+
+
+def test_dense_sp_flash_ring_update(tmp_path):
+    """attention_impl="pallas" routes BOTH the scoring pass and the jitted
+    update through the flash ring (`ring_attention_flash`, differentiable
+    via its global-lse custom_vjp). Same kernels on both sides means the
+    epoch-1 importance ratio is ~1 with ~zero variance — the
+    kernel-consistency property (ADVICE r3; tolerance, not bitwise: the
+    scoring and update programs are separately jitted and XLA may round
+    their surrounding elementwise ops differently) — and the update must
+    actually step the params."""
+    devs = jax.devices()
+    trainer = _make_trainer(
+        tmp_path, "flashring",
+        make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
+        mcfg_replace={"attention_impl": "pallas"},
+        gradient_accumulation_steps=1, num_mini_batches=1, kl_coef=0.05,
+    )
+    before = [x.copy() for x in _lora_leaves(trainer)]
+    trainer.train(num_updates=1)
+    after = _lora_leaves(trainer)
+    assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+
+    rows = _metric_rows(tmp_path / "flashring")
+    assert rows, "no update metrics logged"
+    assert abs(rows[0]["val/ratio_new"] - 1.0) < 1e-5
+    assert rows[0]["val/ratio_var_new"] < 1e-10
