@@ -87,6 +87,22 @@ def resolve_rm_reward(reward_model_path: str, batch_size: int = 16):
     return make_rule_reward(fn)
 
 
+def init_multihost_logged() -> dict:
+    """Multi-host bring-up FIRST (before anything touches the backend):
+    no-op on a single host; on a pod it joins jax.distributed so
+    jax.devices() is the global mesh (parallel/distributed.py). Logs the
+    per-process device counts when running multi-process. Shared by
+    common.run and the r1 launcher."""
+    from nanorlhf_tpu.parallel import initialize_multihost
+
+    dist = initialize_multihost()
+    if dist["process_count"] > 1:
+        print(f"[multihost] process {dist['process_index']}/"
+              f"{dist['process_count']}: {dist['local_device_count']} local "
+              f"/ {dist['global_device_count']} global devices")
+    return dist
+
+
 def run(cfg: RLConfig, value_params_fn=None, post_build=None):
     """Build everything and train — the tail of every launcher.
 
@@ -94,6 +110,7 @@ def run(cfg: RLConfig, value_params_fn=None, post_build=None):
     freshly resolved policy (PPO). `post_build(trainer, dataset, reward_func)`
     runs before training (PPO's value-initializer phase).
     """
+    init_multihost_logged()
     mcfg, params, tokenizer = resolve_model(
         cfg.sft_model_path, cfg.seed, attention_impl=cfg.attention_impl
     )

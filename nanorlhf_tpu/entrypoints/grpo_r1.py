@@ -222,6 +222,9 @@ def make_accuracy_func(eval_qa, max_prompt_len: int = 512,
 def main(cfg: RLConfig | None = None, limit: int | None = None,
          max_prompt_len: int = 512, eval_response_length: int = 1024):
     cfg = cfg or build_config()
+    from nanorlhf_tpu.entrypoints.common import init_multihost_logged
+
+    init_multihost_logged()  # no-op single-host; joins the pod otherwise
     mcfg, params, tokenizer = resolve_model(cfg.sft_model_path, cfg.seed)
     train_qa, eval_qa = load_math_datasets("meta-math/MetaMathQA", "HuggingFaceH4/MATH-500",
                                            limit=limit)

@@ -216,7 +216,6 @@ def test_dense_sp_flash_ring_update(tmp_path):
         tmp_path, "flashring",
         make_mesh(MeshConfig(1, 1, 1, 2), devices=devs[:2]),
         mcfg_replace={"attention_impl": "pallas"},
-        gradient_accumulation_steps=1, num_mini_batches=1, kl_coef=0.05,
     )
     before = [x.copy() for x in _lora_leaves(trainer)]
     trainer.train(num_updates=1)
@@ -225,5 +224,8 @@ def test_dense_sp_flash_ring_update(tmp_path):
 
     rows = _metric_rows(tmp_path / "flashring")
     assert rows, "no update metrics logged"
+    # single minibatch -> ratio_new IS the epoch-1 first-minibatch ratio,
+    # the clean kernel-consistency signal (later minibatches would fold in
+    # genuine update-induced drift; and ratio_var over one entry is 0 by
+    # construction, so asserting it would be vacuous)
     assert abs(rows[0]["val/ratio_new"] - 1.0) < 1e-5
-    assert rows[0]["val/ratio_var_new"] < 1e-10
