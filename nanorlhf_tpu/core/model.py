@@ -389,7 +389,21 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
             return y, None
 
         if remat:
-            body = jax.checkpoint(body)
+            if config.remat_policy == "dots":
+                # keep MXU matmul outputs (no batch dims = the weight
+                # projections, not attention scores) for the backward
+                body = jax.checkpoint(
+                    body,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable,
+                )
+            elif config.remat_policy == "full":
+                body = jax.checkpoint(body)
+            else:
+                raise ValueError(
+                    f"remat_policy={config.remat_policy!r}: must be "
+                    "'full' or 'dots'"
+                )
         x, _ = jax.lax.scan(body, x, (params["layers"], lora_layers))
         return x, None
     else:

@@ -144,6 +144,11 @@ class RLConfig:
     # ---- memory / kernels ----
     gradient_checkpointing: bool = True
     attention_impl: str = "auto"  # xla | pallas | auto (by seq length, on TPU)
+    # remat policy under gradient_checkpointing (core/config.remat_policy):
+    # "full" recomputes whole layers in the backward; "dots" saves the MXU
+    # projection outputs (more HBM, ~1/3 less recompute). Identical
+    # gradients either way — a memory/FLOPs tuning knob.
+    remat_policy: str = "full"  # full | dots
     # "int8": generation reads weight-only-quantized base projections (per-
     # output-channel scales, core/quant.py) — halves decode's HBM weight
     # traffic. LoRA/embeddings stay exact bf16 in the sampler; scoring and

@@ -181,6 +181,16 @@ class RLTrainer:
                 self.mcfg, spmd_mesh=self.mesh,
                 spmd_batch_axes=("data", "fsdp"), spmd_head_axis="tensor",
             )
+        if (config.remat_policy != "full"
+                and config.remat_policy != self.mcfg.remat_policy):
+            # RLConfig only OVERRIDES when set off its default — a caller
+            # who customized ModelConfig.remat_policy directly must not be
+            # silently reverted by an untouched RLConfig
+            import dataclasses as _dc
+
+            self.mcfg = _dc.replace(
+                self.mcfg, remat_policy=config.remat_policy
+            )
         if config.total_episodes is None:
             # episodes-from-epochs parity (`GRPO/grpo_trainer.py:216-217`)
             if not hasattr(dataset, "__len__"):

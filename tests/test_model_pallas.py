@@ -68,3 +68,40 @@ def test_pallas_grad_path_works(rng):
     flat = jax.tree.leaves(g)
     assert all(bool(jnp.all(jnp.isfinite(x))) for x in flat)
     assert any(float(jnp.abs(x).sum()) > 0 for x in flat)
+
+
+def test_remat_policy_gradients_identical(rng):
+    """remat_policy is a memory/FLOPs knob, NOT a numerics one: gradients
+    through the checkpointed layer scan must match between "full"
+    (recompute everything) and "dots" (save MXU projection outputs), and
+    match the unremat'd gradient."""
+    import dataclasses
+
+    import pytest
+
+    from nanorlhf_tpu.core import padded_forward_logits
+
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    ids = jnp.asarray(rng.integers(2, 128, (2, 16)).astype(np.int32))
+
+    def loss(p, cfg, remat):
+        lg = padded_forward_logits(p, cfg, ids, 0, remat=remat)
+        return (lg.astype(jnp.float32) ** 2).mean()
+
+    g_none = jax.grad(loss)(params, mcfg, False)
+    g_full = jax.grad(loss)(params, mcfg, True)
+    g_dots = jax.grad(loss)(
+        params, dataclasses.replace(mcfg, remat_policy="dots"), True
+    )
+    for a, b, c in zip(jax.tree.leaves(g_none), jax.tree.leaves(g_full),
+                       jax.tree.leaves(g_dots)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        jax.grad(loss)(
+            params, dataclasses.replace(mcfg, remat_policy="bogus"), True
+        )
