@@ -89,3 +89,32 @@ def test_sp_fsdp_gradients_sharded_like_params(rng):
     g_ref = jax.grad(loss_ref)(params)
     for a, b in zip(jax.tree.leaves(g_sp), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3)
+
+
+def test_sp_fsdp_flash_ring_grad_matches_xla_ring(rng):
+    """fsdp×sp with the FLASH ring differentiated (attn_impl="pallas"
+    routes `_sp_fsdp_forward_local`'s attention through
+    `ring_attention_flash`, whose custom_vjp backward re-runs the ring
+    with the global lse): gradients of the scored logprobs must match the
+    einsum ("xla") ring's autodiff — the SP update path's kernel choice
+    must not change the update direction."""
+    from nanorlhf_tpu.parallel.sp import sp_score_logprobs
+
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(0), jnp.float32)
+    ids_j, _, _ = _inputs(rng)
+    mesh = _mesh()
+
+    def loss(p, impl):
+        lp = sp_score_logprobs(
+            p, config, ids_j, 0, 1.0, mesh, fsdp_axis="fsdp",
+            attn_impl=impl,
+        )
+        return (lp * (ids_j != 0)).sum()
+
+    g_xla = jax.jit(jax.grad(lambda p: loss(p, "xla")))(params)
+    g_flash = jax.jit(jax.grad(lambda p: loss(p, "pallas")))(params)
+    for a, b in zip(jax.tree.leaves(g_xla), jax.tree.leaves(g_flash)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-4
+        )
