@@ -26,9 +26,11 @@ hardware budget allows. Run on the TPU (default env) or CPU
 Env knobs: LEARN_UPDATES (30), LEARN_MODEL (small8m | tiny), LEARN_PROMPTS
 (32 per update), LEARN_RESPONSE (64), LEARN_LR (1e-2), LEARN_OUT
 (docs/artifacts). LR note: from-scratch models need orders more than the
-fine-tuning 6e-6. Measured on the tiny config (CPU, 25 updates): 3e-4 is
-flat noise, 2e-2 produces a clean 0.13 → 0.27 climb with takeoff around
-update 18. The 8M default starts at 1e-2.
+fine-tuning 6e-6, but too hot COLLAPSES the policy — identical samples →
+zero group advantages → the sparse filter skips the update. Measured on
+CPU: tiny (0.1M) wants 2e-2 (3e-4 is flat noise); small8m (2.9M) at 2e-2
+collapses (33/40 updates skipped), at 8e-3 climbs cleanly 0.15 → 0.66
+over 40 updates with zero skips. Default 8e-3.
 """
 
 from __future__ import annotations
@@ -49,10 +51,14 @@ def model_config(name: str):
 
     if name == "tiny":
         return ModelConfig.qwen2_tiny(vocab_size=512)
-    # ~8M-param decoder: beyond the 336k-param toy of tests/test_learning.py,
-    # small enough that 30 updates fit a tunnel session
+    # ~4M-param decoder: an order beyond the 336k-param toy of
+    # tests/test_learning.py, small enough that ~40 updates fit a tunnel
+    # session (or ~20 min of single-core CPU). Vocab stays 512: the toy
+    # tokenizer's digit-token share sets the reward's base rate, and at
+    # 4096 the digit density is so low that most GRPO groups score
+    # identically zero and the sparse filter skips the update.
     return dataclasses.replace(
-        ModelConfig.qwen2_tiny(vocab_size=4096),
+        ModelConfig.qwen2_tiny(vocab_size=512),
         hidden_size=256,
         intermediate_size=688,
         num_hidden_layers=4,
@@ -138,11 +144,12 @@ def main():
     ids = encode_texts(tok, templated, max_prompt_len=32)
     dataset = PromptDataset(_left_pad(ids, tok.pad_token_id), tok.pad_token_id)
 
-    # fresh run dir: the metrics logger APPENDS, and a stale series from a
-    # previous invocation would silently pollute the committed artifact
+    # pid-unique fresh run dir: the metrics logger APPENDS, and a fixed
+    # path would let a concurrent or stale invocation pollute the committed
+    # artifact (observed: two overlapped runs interleaved one jsonl)
     import shutil
 
-    run_dir = "/tmp/nanorlhf_learning_run"
+    run_dir = f"/tmp/nanorlhf_learning_run.{os.getpid()}"
     shutil.rmtree(run_dir, ignore_errors=True)
     cfg = RLConfig(
         algo=AlgoName.GRPO,
@@ -154,7 +161,7 @@ def main():
         rollout_top_k=0,                 # r1 default: exact nucleus
         sample_n=4,
         kl_coef=0.0,                     # r1: no KL (`grpo_r1.py:138`)
-        learning_rate=float(os.environ.get("LEARN_LR", 1e-2)),
+        learning_rate=float(os.environ.get("LEARN_LR", 8e-3)),
         # LEARN_PROMPTS is the GLOBAL prompts-per-update; the mesh takes
         # every visible device on its data axis (1 on the single-chip
         # tunnel, 8 on the virtual CPU test mesh)
