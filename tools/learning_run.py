@@ -187,6 +187,8 @@ def main():
             "step": r["step"],
             "score": round(r.get("eval_objective/scores_old", 0.0), 4),
             "entropy": round(r.get("objective/entropy_old", 0.0), 3),
+            # response-length growth — the reference's len.png evidence
+            "resp_len": round(r.get("eval_response_length", 0.0), 2),
         }
         for r in rows
         if "eval_objective/scores_old" in r
