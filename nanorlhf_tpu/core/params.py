@@ -165,13 +165,12 @@ def export_hf_checkpoint(
             tokenizer.save_pretrained(out_dir)
 
     # echo the source family when the config carries one (from_hf_config /
-    # load_hf_checkpoint set it); the attention_bias heuristic is only the
-    # random-init fallback (ADVICE r3: a Llama with attention_bias=True
-    # must not round-trip to Qwen2)
-    # only the two families this exporter can faithfully emit: an unknown
-    # slug (e.g. "mistral") must NOT be echoed verbatim — transformers'
-    # AutoConfig would apply that family's defaults (sliding_window, ...)
-    # to keys we never write, silently diverging from the source weights
+    # load_hf_checkpoint set it; a Llama with attention_bias=True must not
+    # round-trip to Qwen2), but only for the two families this exporter can
+    # faithfully emit — an unknown slug (e.g. "mistral") echoed verbatim
+    # would make transformers' AutoConfig apply that family's defaults
+    # (sliding_window, ...) to keys we never write. Anything else falls
+    # back to the attention_bias heuristic, as do random-init configs.
     family = config.model_type if config.model_type in ("qwen2", "llama") \
         else ("qwen2" if config.attention_bias else "llama")
     arch = {"qwen2": "Qwen2ForCausalLM", "llama": "LlamaForCausalLM"}[family]

@@ -475,6 +475,20 @@ def _core_bwd(causal, block_q, block_k, residuals, g):
 _flash_attention_core.defvjp(_core_fwd, _core_bwd)
 
 
+def block_and_pad(block_q: int, block_k: int, T: int) -> tuple[int, int]:
+    """The shared pad-up recipe (used here and by the flash ring): blocks
+    must be 128-lane multiples, never larger than the padded sequence, and
+    T pads UP to a block multiple — a non-aligned T is rejected by Mosaic,
+    and an unpadded partial last block would read out-of-bounds keys that
+    key_valid does not neutralize (silent wrong logprobs on silicon;
+    interpret mode zero-fills and cannot catch it)."""
+    block = max(block_q, block_k)
+    block = max(128, (block // 128) * 128)
+    block = min(block, 128 * int(pl.cdiv(T, 128)))
+    T_pad = int(pl.cdiv(T, block) * block)
+    return block, T_pad
+
+
 def flash_attention(
     q: jnp.ndarray,          # [B, H, T, d]
     k: jnp.ndarray,          # [B, KV, T, d]
@@ -493,12 +507,8 @@ def flash_attention(
     garbage the caller's masking discards.
     """
     B, H, T, d = q.shape
-    block = max(block_q, block_k)
-    block = max(128, (block // 128) * 128)
-    # never use a block larger than the padded sequence itself
-    block = min(block, 128 * int(pl.cdiv(T, 128)))
+    block, T_pad = block_and_pad(block_q, block_k, T)
     block_q = block_k = block
-    T_pad = int(pl.cdiv(T, block) * block)
     if T_pad != T:
         pad = [(0, 0), (0, 0), (0, T_pad - T), (0, 0)]
         q = jnp.pad(q, pad)

@@ -100,34 +100,22 @@ def _merge_partials(o1, l1, o2, l2):
     return o, m + jnp.log(den)
 
 
-def _ring_block(block_q: int, block_k: int, T: int):
-    """flash_attention's pad-up recipe (ops/attention.py): blocks must be
-    128-lane multiples and T must pad UP to a block multiple — a
-    non-aligned T_local is rejected by Mosaic, and an unpadded partial
-    last block would read out-of-bounds keys that key_valid does not
-    neutralize (silent wrong logprobs on silicon; interpret mode
-    zero-fills and cannot catch it)."""
-    from jax.experimental import pallas as pl
-
-    block = max(block_q, block_k)
-    block = max(128, (block // 128) * 128)
-    block = min(block, 128 * int(pl.cdiv(T, 128)))
-    T_pad = int(pl.cdiv(T, block) * block)
-    return block, T_pad
-
-
 def _ring_flash_fwd_loop(q, k, v, key_valid, axis_name, causal, block_q,
                          block_k):
     """The flash ring forward: per-chunk Pallas kernel + lse merge. Returns
     (out_f32 [B,H,T,d], lse [B,H,T] f32) — lse is the GLOBAL logsumexp over
     the full (sharded) sequence, the backward residual."""
-    from nanorlhf_tpu.ops.attention import _flash_forward, _interpret_default
+    from nanorlhf_tpu.ops.attention import (
+        _flash_forward,
+        _interpret_default,
+        block_and_pad,
+    )
 
     my_idx = jax.lax.axis_index(axis_name)
     n = jax.lax.psum(1, axis_name)
     B, H, T, d = q.shape
     interpret = _interpret_default()
-    block, T_pad = _ring_block(block_q, block_k, T)
+    block, T_pad = block_and_pad(block_q, block_k, T)
     q_pad = q
     if T_pad != T:
         q_pad = jnp.pad(q, [(0, 0), (0, 0), (0, T_pad - T), (0, 0)])
@@ -208,6 +196,7 @@ def _ring_core_bwd(axis_name, causal, block_q, block_k, residuals, g):
         _LANES,
         _flash_backward,
         _interpret_default,
+        block_and_pad,
     )
 
     q, k, v, key_valid, out, lse = residuals
@@ -216,7 +205,7 @@ def _ring_core_bwd(axis_name, causal, block_q, block_k, residuals, g):
     B, H, T, d = q.shape
     KV = k.shape[1]
     interpret = _interpret_default()
-    block, T_pad = _ring_block(block_q, block_k, T)
+    block, T_pad = block_and_pad(block_q, block_k, T)
 
     pad4 = [(0, 0), (0, 0), (0, T_pad - T), (0, 0)]
     q_pad, out_pad, g_pad, lse_pad = q, out, g, lse

@@ -294,14 +294,10 @@ class SparseGRPOTrainer(RLTrainer):
             acc = float(self.accuracy_func(self))
             self.logger.log(0, 0, {"initial_accuracy": acc})
 
-        # the single-model scorer branches to the SP variant when sp is on;
-        # ref-free mode scores the POLICY with it (adapters applied via
-        # _policy_score_fn), capture mode scores the ref
+        # the single-model scorer branches to the SP variant when sp is on
+        # (see RLTrainer._single_scorer_for for the ref-free/capture matrix)
         capture = cfg.sampler_logprob_capture
-        if self._ref_free:
-            ref_fn = None if capture else self._policy_score_fn()
-        else:
-            ref_fn = self._ref_score_fn() if capture else None
+        ref_fn = self._single_scorer_for(capture)
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,

@@ -732,6 +732,16 @@ class RLTrainer:
         replacement for the two-model chunk scorer."""
         return self._single_score_fn(self.lora_scale)
 
+    def _single_scorer_for(self, capture: bool):
+        """The single-model scorer the scoring loop needs, or None when no
+        single-model pass runs: ref-free scores the POLICY (unless capture
+        already supplies it — then nothing is left to score), ref-full +
+        capture scores the REF, ref-full without capture uses the two-model
+        chunk scorer instead. Shared by the dense and sparse loops."""
+        if self._ref_free:
+            return None if capture else self._policy_score_fn()
+        return self._ref_score_fn() if capture else None
+
     # ------------------------------------------------------------------ #
     # the training loop
     # ------------------------------------------------------------------ #
@@ -879,12 +889,7 @@ class RLTrainer:
             chunk = max(1, min(total, chunk))
             logprobs_l, ref_logprobs_l = [], []
             ref_free = self._ref_free
-            if ref_free:
-                # policy-only scorer (adapters applied); None when capture
-                # also supplies the policy side — nothing left to score
-                one_fn = None if capture else self._policy_score_fn()
-            else:
-                one_fn = self._ref_score_fn() if capture else None
+            one_fn = self._single_scorer_for(capture)
             with self.timer.phase("logprob"):
                 if ref_free and capture:
                     # zero scoring forwards: policy logprobs came from the
