@@ -76,6 +76,38 @@ def _error_payload(msg: str, **detail) -> dict:
     }
 
 
+def _load_by_path(mod_name: str, *relpath: str):
+    """Load a repo module by FILE PATH — no package import (nanorlhf_tpu's
+    __init__ pulls jax, which the bench parent must never do) and no
+    sys.path mutation (which would let repo files shadow stdlib names)."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), *relpath)
+    spec = importlib.util.spec_from_file_location(mod_name, p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_compile_cache_mod():
+    return _load_by_path("_bench_compile_cache",
+                         "nanorlhf_tpu", "utils", "compile_cache.py")
+
+
+def _remove_child_sentinel(pid: int) -> None:
+    """A SIGKILLed measurement child can't clean its compile-cache claim
+    (no atexit, no signal handler runs) — if the parent didn't remove it,
+    the next cache writer would read the dead sentinel as a crash and wipe
+    the shared cache, costing a full recompile per bench timeout."""
+    try:
+        mod = _load_compile_cache_mod()
+        d = mod.default_cache_dir()
+        if d:
+            os.remove(mod.sentinel_path(d, pid))
+    except Exception:
+        pass  # no cache dir / no sentinel — nothing to clean
+
+
 def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
     """Run the measurement child; return (payload_or_None, error_tail).
 
@@ -84,16 +116,28 @@ def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
     child is killed — the parent interpreter stays clean for a retry.
     """
     env = {**os.environ, "BENCH_CHILD": "1", **extra_env}
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+    )
     try:
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-        )
-    except subprocess.TimeoutExpired as e:
-        tail = ((e.stderr or b"").decode() if isinstance(e.stderr, bytes)
-                else (e.stderr or ""))[-500:]
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except Exception:
+            stderr = ""
+        # only reap the sentinel once the child is CONFIRMED dead: a child
+        # stuck in uninterruptible I/O on a dead relay pends the SIGKILL,
+        # and removing a live child's claim would let a concurrent writer's
+        # heal wipe the cache under it (the pid-liveness check in
+        # heal_and_claim handles an unremoved sentinel correctly either way)
+        if proc.poll() is not None:
+            _remove_child_sentinel(proc.pid)
+        tail = (stderr or "")[-500:]
         return None, f"child timed out after {timeout_s:.0f}s; stderr: {tail}"
-    for line in reversed(out.stdout.strip().splitlines()):
+    for line in reversed(stdout.strip().splitlines()):
         try:
             payload = json.loads(line)
             if isinstance(payload, dict) and "metric" in payload:
@@ -107,10 +151,21 @@ def _run_child(extra_env: dict, timeout_s: float) -> tuple[dict | None, str]:
                 return payload, ""
         except json.JSONDecodeError:
             continue
-    return None, (out.stderr or out.stdout).strip()[-800:]
+    return None, (stderr or stdout).strip()[-800:]
 
 
-_RELAY_PORTS = (8082, 8092, 8102, 8112)  # axon loopback-relay listen ports
+def _relay_ports() -> tuple:
+    """Port set lives in tools/tunnel_alive.py (shared with the session/
+    watch scripts); falls back to the historical set if the load fails
+    (bench.py must stay runnable standalone)."""
+    try:
+        return _load_by_path("_bench_tunnel_alive",
+                             "tools", "tunnel_alive.py").RELAY_PORTS
+    except Exception:
+        return (8082, 8092, 8102, 8112)
+
+
+_RELAY_PORTS = _relay_ports()
 
 
 def _tunnel_alive() -> bool | None:
@@ -323,6 +378,11 @@ def main():
     try:
         import jax
 
+        from nanorlhf_tpu.utils.compile_cache import enable_compilation_cache
+
+        # persistent compile cache: warm-started sessions spend tunnel time
+        # measuring, not recompiling the bucket menu (VERDICT r4 #2)
+        enable_compilation_cache()
         jax.devices()  # force backend init inside the bounded child
         return run_bench(jax, os.environ.get("BENCH_TPU_ERROR") or None)
     except Exception as e:  # one parseable line, never a bare stack trace
@@ -456,6 +516,7 @@ def run_bench(jax, init_error):
             "rollout_quant": r_quant,
             "kv_cache_quant": kv_quant,
             "rollout_ahead": ahead,
+            "rollout_shared_prefill": cfg.rollout_shared_prefill,
             "sampler_logprob_capture": capture,
             "response_length": resp,
             "sec_per_update_steady": round(sec, 3),
@@ -637,6 +698,15 @@ def run_bench(jax, init_error):
             "0.0: run not comparable to the A100 baseline "
             f"(backend={backend}, model={model_name}, "
             f"response_length={response_len})"
+        )
+    elif chosen.get("sampler_logprob_capture"):
+        # the sweep may promote a capture-mode run (approximate
+        # old-logprobs, one fewer scoring forward) to the headline; keep
+        # the comparability shift visible next to vs_baseline
+        detail["vs_baseline_note"] = (
+            "chosen config uses sampler_logprob_capture=True (decode-time "
+            "old-logprobs, scoring forwards halved) — the A100 baseline "
+            "rescores rollouts; see detail.sweep for the full-scoring time"
         )
     if init_error is not None:
         payload["error"] = f"TPU unavailable, CPU fallback: {init_error[-300:]}"

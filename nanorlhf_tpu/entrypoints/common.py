@@ -92,8 +92,14 @@ def init_multihost_logged() -> dict:
     no-op on a single host; on a pod it joins jax.distributed so
     jax.devices() is the global mesh (parallel/distributed.py). Logs the
     per-process device counts when running multi-process. Shared by
-    common.run and the r1 launcher."""
+    common.run and the r1 launcher. Also the single place every launcher
+    passes through before compiling anything, so the persistent compile
+    cache is enabled here (compile time is the scarcest resource on a
+    tunneled TPU)."""
     from nanorlhf_tpu.parallel import initialize_multihost
+    from nanorlhf_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()
 
     dist = initialize_multihost()
     if dist["process_count"] > 1:

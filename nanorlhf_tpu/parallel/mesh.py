@@ -164,3 +164,36 @@ def shard_params(params, mesh: Mesh, rules=None):
 def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard the batch dim over (data, fsdp); replicate other dims."""
     return NamedSharding(mesh, P(("data", "fsdp"), *([None] * (ndim - 1))))
+
+
+def split_rollout_devices(devices, k: int):
+    """(train_devices, rollout_devices): reserve `k` devices for generation.
+
+    The disaggregated-rollout layout (trainer `rollout_devices`): training
+    runs on one device group, generation on another, with one param sync
+    per update crossing between them. On a multi-slice pod the reservation
+    prefers WHOLE slices (highest slice_index first) so the rollout mesh's
+    own collectives stay on ICI and only the param sync rides DCN; when no
+    suffix of whole slices sums to `k` (or on hosts without slice_index,
+    e.g. CPU test meshes) it falls back to the id-ordered tail."""
+    if not 0 < k < len(devices):
+        raise ValueError(
+            f"rollout_devices={k} must leave >=1 of {len(devices)} devices "
+            "for training"
+        )
+    if all(hasattr(d, "slice_index") for d in devices):
+        by_slice = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        picked = []
+        for s in sorted(by_slice, reverse=True):
+            if len(picked) + len(by_slice[s]) > k:
+                break
+            picked.extend(by_slice[s])
+        if len(picked) == k:
+            picked_ids = {d.id for d in picked}
+            train = [d for d in devices if d.id not in picked_ids]
+            return (sorted(train, key=lambda d: d.id),
+                    sorted(picked, key=lambda d: d.id))
+    ordered = sorted(devices, key=lambda d: d.id)
+    return ordered[:-k], ordered[-k:]

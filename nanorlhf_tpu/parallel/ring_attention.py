@@ -212,10 +212,11 @@ def _ring_core_bwd(axis_name, causal, block_q, block_k, residuals, g):
     if T_pad != T:
         q_pad = jnp.pad(q, pad4)
         out_pad = jnp.pad(out, pad4)
-        g_pad = jnp.pad(g.astype(out.dtype), pad4)
+        # g stays f32 (pad only): the bwd kernels cast operands internally,
+        # and the single-device path feeds them the f32 cotangent — casting
+        # here made ring gradients differ at bf16-rounding level
+        g_pad = jnp.pad(g, pad4)
         lse_pad = jnp.pad(lse, [(0, 0), (0, 0), (0, T_pad - T)])
-    else:
-        g_pad = g.astype(out.dtype)
     # the bwd kernels read lse lane-expanded (ops/attention.py layout)
     lse_lanes = jnp.broadcast_to(
         lse_pad[..., None], (B, H, T_pad, _LANES)

@@ -43,7 +43,7 @@ _prefill_state_jit = partial(
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
                      "temperature", "top_p", "greedy", "lora_scale", "top_k",
-                     "capture_logprobs", "approx_top_k"),
+                     "capture_logprobs", "approx_top_k", "prompt_fanout"),
 )(_prefill_state)
 
 
@@ -146,6 +146,7 @@ def generate_tokens_compact(
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
     batch_sharding=None,
+    prompt_fanout: int = 1,
 ):
     """Segmented decode with batch compaction. Same output contract as
     `generate_tokens`; host-orchestrated (syncs once per segment).
@@ -156,6 +157,7 @@ def generate_tokens_compact(
     count and the gathered carry is re-laid-out under that sharding, so the
     compacted KV cache stays sharded instead of replicating."""
     B0, Tp = prompt_ids.shape
+    B0 = B0 * prompt_fanout  # physical decode rows after shared-prefill fanout
     min_batch = _MIN_BATCH
     if batch_sharding is not None:
         min_batch = max(min_batch, _batch_axis_size(batch_sharding))
@@ -166,7 +168,7 @@ def generate_tokens_compact(
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
     )
     state = _prefill_state_jit(params, config, prompt_ids, prompt_mask, key,
-                               **kw)
+                               prompt_fanout=prompt_fanout, **kw)
 
     final_out = np.full((B0, max_tokens), pad_token_id, np.int32)
     final_lp = np.zeros((B0, max_tokens), np.float32)

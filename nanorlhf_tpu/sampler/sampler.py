@@ -76,6 +76,15 @@ class SamplingParams:
     # static-shape analogue of vLLM's continuous batching. 0 = monolithic
     # single-jit loop (bit-stable row streams, fully async dispatch).
     compaction_segments: int = 0
+    # n>1: prefill each prompt ONCE and fan the prompt KV out to its N
+    # samples inside the jit, instead of repeating the prompt rows before
+    # prefill — ÷N prefill FLOPs and prompt activation memory, the
+    # TPU-static analogue of vLLM's prefix sharing for `n=4` requests
+    # (`/root/reference/GRPO/grpo_trainer.py:127`). Token streams are
+    # IDENTICAL to the repeat path (test-pinned): the fanned-out first
+    # logits and caches match the repeated rows' bit for bit, and decode
+    # runs on the same [B*N] shapes either way.
+    shared_prompt_prefill: bool = True
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -109,10 +118,13 @@ def top_p_filter_bisect(logits: jnp.ndarray, top_p: float,
     decreasing step function of tau, so tau comes from bisection over
     (0, p_max]: `iters` reduction passes over [B, V] (VPU-friendly
     elementwise+sum, no data movement) instead of a sort. 26 iterations
-    drive the bracket below f32 resolution of p_max (2^-24), so any
-    difference vs the oracle sits inside a float tie the sort itself
-    cannot order stably either. Used by `_sample_token` for the
-    `top_k=0` exact-nucleus path (the r1-zero launcher default).
+    leave an ABSOLUTE bracket of ~p_max·2^-26 ≈ 1.5e-8: near the top of
+    the distribution that is inside f32 tie noise the sort cannot order
+    stably either, but a token whose probability sits within ~1.5e-8
+    BELOW the true cutoff can still be kept — for small-threshold tails
+    at LLM vocab sizes this admits negligible extra tail mass rather
+    than being bit-exact. Used by `_sample_token` for the `top_k=0`
+    nucleus path (the r1-zero launcher default).
     """
     probs = jax.nn.softmax(logits, axis=-1)
     p_max = jnp.max(probs, axis=-1, keepdims=True)
@@ -187,7 +199,7 @@ def _token_logprob(logits, tok, temperature):
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
                      "temperature", "top_p", "greedy", "lora_scale", "top_k",
-                     "capture_logprobs", "approx_top_k"),
+                     "capture_logprobs", "approx_top_k", "prompt_fanout"),
 )
 def generate_tokens(
     params: dict,
@@ -206,9 +218,12 @@ def generate_tokens(
     top_k: int = 64,
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
+    prompt_fanout: int = 1,
 ) -> jnp.ndarray:
-    """Core jitted loop: one sample per row. Returns [B, max_tokens] int32,
-    or (tokens, logprobs [B, max_tokens] f32) with capture_logprobs."""
+    """Core jitted loop: one sample per row. Returns [B*fanout, max_tokens]
+    int32, or (tokens, logprobs f32) with capture_logprobs. `prompt_fanout`
+    N prefills the [B] prompts once and decodes N samples per prompt
+    (prompt-major rows), sharing the prompt KV."""
     Tp = prompt_ids.shape[1]
     state = _prefill_state(
         params, config, prompt_ids, prompt_mask, key,
@@ -216,6 +231,7 @@ def generate_tokens(
         pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
         greedy=greedy, lora_scale=lora_scale, top_k=top_k,
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+        prompt_fanout=prompt_fanout,
     )
 
     def cond(state):
@@ -237,11 +253,20 @@ def generate_tokens(
 def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
                    max_tokens, eos_token_id, pad_token_id, temperature,
                    top_p, greedy, lora_scale, top_k, capture_logprobs,
-                   approx_top_k):
+                   approx_top_k, prompt_fanout=1):
     """Prefill + first sampled token → the decode-loop carry state:
     (step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key).
     Per-step sampling keys are fold_in(key, step), so a segment boundary
-    (compaction.py) resumes the identical stream."""
+    (compaction.py) resumes the identical stream.
+
+    `prompt_fanout` N: the prompts arrive UN-repeated; prefill runs on the
+    [B] rows once, then the first logits, prompt KV, and per-row metadata
+    fan out ×N (prompt-major, matching `jnp.repeat(..., n, axis=0)` row
+    order) before the first token is sampled. Everything downstream —
+    including the [B*N]-shaped categorical draw — is then identical to
+    prefilling N repeated copies, at 1/N the prefill FLOPs. The interleaved
+    repeat is collective-free under a data-sharded batch: each device's row
+    block fans out to its own contiguous output block."""
     B, Tp = prompt_ids.shape
     T_max = Tp + max_tokens
     prompt_mask = prompt_mask.astype(bool)
@@ -250,6 +275,15 @@ def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
     caches = init_kv_cache(config, B, T_max, dtype)
     first_logits, caches = prefill(params, config, prompt_ids, prompt_mask, caches,
                                    lora_scale=lora_scale)
+
+    if prompt_fanout > 1:
+        first_logits = jnp.repeat(first_logits, prompt_fanout, axis=0)
+        # caches are stacked [L, B, KV, T, d] — batch on axis 1
+        caches = jax.tree.map(
+            lambda c: jnp.repeat(c, prompt_fanout, axis=1), caches
+        )
+        prompt_mask = jnp.repeat(prompt_mask, prompt_fanout, axis=0)
+        B = B * prompt_fanout
 
     prompt_len = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # real prompt length
     key_mask0 = jnp.zeros((B, T_max), bool).at[:, :Tp].set(prompt_mask)
@@ -311,9 +345,14 @@ def generate(
 
     `batch_sharding` (optional NamedSharding over the batch axes) is only
     consumed by the compacting path, which re-lays-out gathered carries."""
+    fanout = 1
     if sampling.n > 1:
-        prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
-        prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
+        if sampling.shared_prompt_prefill:
+            # prompts stay [B]; prefill-once-fan-out happens inside the jit
+            fanout = sampling.n
+        else:
+            prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
+            prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
     if sampling.compaction_segments > 0:
         from nanorlhf_tpu.sampler.compaction import generate_tokens_compact
 
@@ -326,6 +365,7 @@ def generate(
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k,
             batch_sharding=batch_sharding,
+            prompt_fanout=fanout,
         )
     return generate_tokens(
         params,
@@ -343,4 +383,5 @@ def generate(
         top_k=sampling.top_k,
         capture_logprobs=sampling.capture_logprobs,
         approx_top_k=sampling.approx_top_k,
+        prompt_fanout=fanout,
     )

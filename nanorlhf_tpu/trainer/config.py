@@ -71,6 +71,11 @@ class RLConfig:
     # approx_max_k for the pre-trim (hardware-native O(V); recall 0.99) vs
     # exact lax.top_k (full-vocab sort). Ignored when rollout_top_k=0.
     rollout_approx_top_k: bool = True
+    # n>1 rollouts prefill each prompt once and fan the prompt KV out to its
+    # N samples (vLLM prefix-sharing analogue; token streams are identical
+    # to the repeat path, test-pinned). Off = repeat every prompt ×N before
+    # prefill (ablation/debug).
+    rollout_shared_prefill: bool = True
 
     # ---- batch hierarchy ----
     # total_episodes=None → num_train_epochs × dataset size, resolved by the
@@ -97,6 +102,21 @@ class RLConfig:
     # losses do (`REINFORCE/reinforce_trainer.py:637`). Rollout PRNG comes
     # from a dedicated stream, so update 1 is bit-identical either way.
     rollout_ahead: bool = False
+    # >0: DISAGGREGATED rollouts — reserve this many devices (a whole slice
+    # on multi-slice pods, parallel/mesh.split_rollout_devices) as a
+    # dedicated generation mesh; the training mesh spans the rest. Each
+    # dispatch syncs the rollout param view onto the generation mesh (the
+    # only cross-group transfer; on a pod it rides DCN once per update),
+    # and with rollout_ahead=True generation for update k+1 runs on its own
+    # devices WHILE update k trains — overlapping the two device-bound
+    # phases, not just device-vs-host. 0 = generation shares the training
+    # mesh. Requires the trainer to build its own meshes (mesh=None).
+    rollout_devices: int = 0
+    # mesh layout for the reserved generation devices (rollout_devices>0):
+    # default data=-1 → pure data-parallel over the reserved group with
+    # params replicated per device — right for models that fit one chip;
+    # set tensor/fsdp for bigger policies.
+    rollout_mesh: Optional["MeshConfig"] = None
 
     # ---- optimization ----
     learning_rate: float = 6e-6

@@ -303,6 +303,7 @@ class SparseGRPOTrainer(RLTrainer):
             max_tokens=cfg.response_length, capture_logprobs=capture,
             compaction_segments=cfg.rollout_compaction_segments,
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
+            shared_prompt_prefill=cfg.rollout_shared_prefill,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])
@@ -312,6 +313,12 @@ class SparseGRPOTrainer(RLTrainer):
         def rollout_body(queries, gk):
             """DISPATCH one rollout (async — nothing blocks until fetched)."""
             q_j = jnp.asarray(queries)
+            if self.rollout_mesh is not None:
+                from nanorlhf_tpu.parallel.mesh import batch_sharding
+
+                # disaggregated rollouts: prompts land on the generation
+                # mesh; _rollout_params() re-shards the param view there
+                q_j = jax.device_put(q_j, batch_sharding(self.rollout_mesh))
             gen_out = generate(
                 self._rollout_params(), self._rollout_mcfg, q_j, q_j != pad_id, gk,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,

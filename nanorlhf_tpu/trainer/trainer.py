@@ -64,7 +64,8 @@ from nanorlhf_tpu.ops.masking import (
     response_padding_masks,
     truncate_response,
 )
-from nanorlhf_tpu.parallel.mesh import batch_sharding, make_mesh, shard_params
+from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
+                                        shard_params)
 from nanorlhf_tpu.sampler import SamplingParams, generate
 from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
 from nanorlhf_tpu.trainer.config import AlgoName, RLConfig
@@ -169,7 +170,30 @@ class RLTrainer:
         self.reward_func = reward_func
         self.algo = config.algo
 
-        self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
+        # disaggregated rollouts (config.rollout_devices>0): generation gets
+        # its own device group + mesh; training spans the rest. The trainer
+        # owns both meshes — an externally built mesh can't be split safely.
+        self.rollout_mesh = None
+        self._disagg_base = None  # rollout-mesh copy of the frozen LoRA base
+        if config.rollout_devices > 0:
+            if mesh is not None:
+                raise ValueError(
+                    "rollout_devices>0 builds its own train+rollout meshes; "
+                    "pass mesh=None"
+                )
+            from nanorlhf_tpu.parallel.mesh import split_rollout_devices
+
+            train_dev, roll_dev = split_rollout_devices(
+                jax.devices(), config.rollout_devices
+            )
+            self.mesh = make_mesh(config.mesh, devices=train_dev)
+            self.rollout_mesh = make_mesh(
+                config.rollout_mesh if config.rollout_mesh is not None
+                else MeshConfig(),
+                devices=roll_dev,
+            )
+        else:
+            self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         # Pallas-kernel SPMD hints (core/config.py spmd_mesh): on a mesh
         # whose batch/tensor axes span >1 device the kernel call sites must
         # shard_map themselves or GSPMD all-gathers their operands
@@ -315,6 +339,20 @@ class RLTrainer:
             _dc.replace(self.mcfg, kv_cache_quant=config.kv_cache_quant)
             if config.kv_cache_quant != self.mcfg.kv_cache_quant else self.mcfg
         )
+        if self.rollout_mesh is not None:
+            # generation compiles against the ROLLOUT mesh: its kernel SPMD
+            # hints must name that mesh (the train-mesh hints inherited from
+            # self.mcfg would shard_map kernels over devices generation
+            # doesn't run on)
+            rsh = self.rollout_mesh.shape
+            multi = (rsh.get("data", 1) * rsh.get("fsdp", 1)
+                     * rsh.get("tensor", 1)) > 1
+            self._rollout_mcfg = _dc.replace(
+                self._rollout_mcfg,
+                spmd_mesh=self.rollout_mesh if multi else None,
+                spmd_batch_axes=("data", "fsdp"),
+                spmd_head_axis="tensor",
+            )
         # opt_steps counts ACTUAL optimizer.update calls — the schedule index
         # for the `lr` metric (a derived formula drifts when the minibatch
         # loop doesn't divide evenly)
@@ -337,14 +375,34 @@ class RLTrainer:
     def _rollout_params(self):
         """The param tree generation samples from: exact everywhere, except
         int8 base projections when rollout_quant is on (LoRA/embed/norm are
-        always the live exact arrays — see core/quant.py)."""
+        always the live exact arrays — see core/quant.py). With a dedicated
+        rollout mesh, the view is re-sharded onto it here — the once-per-
+        dispatch param sync (an async device_put tree; the only transfer
+        that crosses the train/rollout device groups)."""
         if self._quant_layers is None:
-            return self.params
-        if not self.cfg.use_lora:  # full FT: base changed since last update
-            self._refresh_quant_layers()
-        from nanorlhf_tpu.core.quant import rollout_view
+            tree = self.params
+        else:
+            if not self.cfg.use_lora:  # full FT: base changed since last update
+                self._refresh_quant_layers()
+            from nanorlhf_tpu.core.quant import rollout_view
 
-        return rollout_view(self.params, self._quant_layers)
+            tree = rollout_view(self.params, self._quant_layers)
+        if self.rollout_mesh is not None:
+            if self.cfg.use_lora:
+                # LoRA freezes the base: re-shard it onto the rollout mesh
+                # ONCE and reuse; per dispatch only the live adapter subtree
+                # (MBs, not the GBs of base projections) crosses the
+                # train/rollout device groups
+                if self._disagg_base is None:
+                    self._disagg_base = shard_params(
+                        {k: v for k, v in tree.items() if k != "lora"},
+                        self.rollout_mesh,
+                    )
+                live = shard_params({"lora": tree["lora"]}, self.rollout_mesh)
+                tree = {**self._disagg_base, **live}
+            else:
+                tree = shard_params(tree, self.rollout_mesh)
+        return tree
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -760,6 +818,7 @@ class RLTrainer:
             max_tokens=cfg.response_length, capture_logprobs=capture,
             compaction_segments=cfg.rollout_compaction_segments,
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
+            shared_prompt_prefill=cfg.rollout_shared_prefill,
         )
 
         # after a resume, the default budget is the REMAINING updates, not a
@@ -782,7 +841,9 @@ class RLTrainer:
                 queries = depad_queries(queries, pad_id, ctx_menu)
             if self._sp_on():
                 self._sp_check_widths(queries.shape[1])
-            bs = batch_sharding(self.mesh)
+            bs = batch_sharding(
+                self.mesh if self.rollout_mesh is None else self.rollout_mesh
+            )
             queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
             gen_params = self._rollout_params()

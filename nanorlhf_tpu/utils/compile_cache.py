@@ -1,0 +1,201 @@
+"""Persistent XLA compilation cache shared by launchers, bench, and tests.
+
+Compile time is the scarcest resource on a tunneled TPU: the r1 bucket menu
+(multiple context × response shapes + sp variants) recompiles every process,
+and BENCH_r04 measured 23.6 s of compile for a *tiny* model on CPU. jax's
+persistent cache turns the second process's compiles into disk loads — but
+only if every entrypoint actually enables it, with a directory that survives
+across sessions and is keyed so entries from a different jaxlib or host CPU
+never load (XLA:CPU AOT results embed host vector extensions; a carried-over
+cache on this host flipped sampled tokens, and mismatched extensions SIGILL).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def host_fingerprint() -> str:
+    """jax/jaxlib version + host CPU feature flags, hashed short.
+
+    The version pair matters because XLA:CPU AOT results embed
+    version-dependent target tuning; the cpuinfo flags line matters because
+    AOT code for wider vector extensions aborts on narrower hosts.
+    """
+    try:
+        from importlib.metadata import version
+
+        ver = f"{version('jax')}-{version('jaxlib')}"
+    except Exception:
+        ver = "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            content = f.read()
+        for key in ("flags", "Features"):  # x86 / aarch64 spellings
+            for line in content.splitlines():
+                if line.startswith(key):
+                    return hashlib.sha1((ver + line).encode()).hexdigest()[:12]
+        # unknown layout: hash the whole thing (may over-rotate on per-boot
+        # fields, but never under-distinguishes vector extensions)
+        return hashlib.sha1((ver + content).encode()).hexdigest()[:12]
+    except OSError:
+        import platform
+
+        key = f"{ver}-{platform.machine()}-{platform.processor()}"
+        return hashlib.sha1(key.encode()).hexdigest()[:12]
+
+
+def default_cache_dir() -> str | None:
+    """Repo-root `.jax_cache_<fingerprint>` (persists across driver rounds);
+    `NANORLHF_CACHE_DIR` overrides; `NANORLHF_CACHE_DIR=0` disables (None)."""
+    override = os.environ.get("NANORLHF_CACHE_DIR")
+    if override == "0":
+        return None
+    if override:
+        return override
+    repo_root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..")
+    )
+    return os.path.join(repo_root, f".jax_cache_{host_fingerprint()}")
+
+
+# sentinel naming is OWNED here — external cleaners (bench.py's parent
+# removing a SIGKILLed child's claim, conftest's session-finish removal)
+# must build paths through sentinel_path(), never re-derive the format
+SENTINEL_PREFIX = ".suite_in_progress."
+
+
+def sentinel_path(cache_dir: str, pid: int | None = None) -> str:
+    return os.path.join(cache_dir, f"{SENTINEL_PREFIX}{pid or os.getpid()}")
+
+
+def _pid_alive(pid: int) -> bool:
+    if pid <= 0:
+        # a corrupt/empty sentinel parses to -1; os.kill(-1, 0) signals the
+        # whole process group and SUCCEEDS — treat nonpositive pids as dead
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except PermissionError:
+        return True  # alive, owned by another user — must NOT wipe under it
+    except (ProcessLookupError, ValueError, OSError):
+        return False
+
+
+def heal_and_claim(path: str) -> str:
+    """Crash-heal the cache dir, then plant a pid sentinel for this process.
+
+    A process that dies hard (SIGKILL mid-write, native abort) can leave a
+    corrupt cache entry that SIGABRTs every later run at load time
+    (observed). Sentinels mark cache users in progress, PID-AWARE: a
+    sentinel whose pid is dead marks a crash; the dir is wiped only when a
+    crash marker exists AND no live process holds the cache (a naive
+    "sentinel exists → wipe" destroyed the cache under a concurrent run).
+    EVERY writer must claim — launchers, bench, tools, and pytest all share
+    this dir, so an unclaimed writer would be invisible to the healer (its
+    crashes never heal) and unprotected from it (a heal could rmtree under
+    it). Returns the sentinel path; the atexit hook removes it."""
+    import atexit
+    import glob
+    import signal
+
+    os.makedirs(path, exist_ok=True)
+    # the scan→wipe→claim sequence must be serialized: two processes
+    # starting together (a pod launch starts N at once) could both read
+    # "crash, no live holder", then one's rmtree deletes the other's fresh
+    # sentinel and entries. flock releases automatically on process death,
+    # so a crashed lock holder can't wedge later claims.
+    lock_fd = None
+    try:
+        import fcntl
+
+        lock_fd = os.open(os.path.join(os.path.dirname(path) or ".",
+                                       os.path.basename(path) + ".lock"),
+                          os.O_CREAT | os.O_RDWR)
+        fcntl.flock(lock_fd, fcntl.LOCK_EX)
+    except Exception:
+        lock_fd = None  # no fcntl / exotic fs: proceed unlocked (best effort)
+    try:
+        saw_crash = saw_live = False
+        for f in glob.glob(os.path.join(path, SENTINEL_PREFIX + "*")):
+            try:
+                pid = int(open(f).read().strip() or -1)
+            except (OSError, ValueError):
+                pid = -1
+            if _pid_alive(pid):
+                saw_live = True
+            else:
+                saw_crash = True
+                try:
+                    os.remove(f)
+                except OSError:
+                    pass
+        if saw_crash and not saw_live:
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+            os.makedirs(path, exist_ok=True)
+        sentinel = sentinel_path(path)
+        with open(sentinel, "w") as f:
+            f.write(str(os.getpid()))
+    finally:
+        if lock_fd is not None:
+            try:
+                os.close(lock_fd)  # closing releases the flock
+            except OSError:
+                pass
+
+    def _cleanup():
+        try:
+            os.remove(sentinel)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup)
+    # Timeout kills are ROUTINE for cache writers here (silicon_session.sh
+    # bounds every step with coreutils `timeout` → SIGTERM), and Python's
+    # default SIGTERM action skips atexit — the stale sentinel would read
+    # as a crash and make the NEXT writer wipe the whole shared cache,
+    # i.e. a designed event (step timeout on a flaky tunnel) would cost a
+    # full bucket-menu recompile. Remove the sentinel on SIGTERM, then
+    # re-raise with the default action. Only installed when no one else
+    # claimed the signal; SIGKILLed children are cleaned by their killing
+    # parent instead (bench.py) or healed as genuine crashes.
+    try:
+        if signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL, None):
+
+            def _on_term(signum, frame):
+                _cleanup()
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # not the main thread — atexit still covers clean exits
+    return sentinel
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at a fingerprinted dir,
+    with crash-heal + pid-sentinel claim (see `heal_and_claim`).
+
+    Idempotent; safe to call before or after backend init (the config only
+    has to be set before the first compile). Returns the dir, or None when
+    disabled (`NANORLHF_CACHE_DIR=0`) or unsupported by this jax.
+    """
+    import jax
+
+    path = cache_dir or default_cache_dir()
+    if path is None:
+        return None
+    try:
+        heal_and_claim(path)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # persist even sub-second compiles: a session's worth of small jits
+        # (reward shaping, metric reductions) adds up over a tunnel
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        return None  # older jax / read-only fs — run uncached
+    return path

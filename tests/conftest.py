@@ -25,114 +25,30 @@ jax.config.update("jax_enable_x64", False)
 
 # Persistent XLA compilation cache: jit compiles dominate suite wall time on
 # small hosts; repeat runs (CI / driver rounds) reuse executables from disk.
-# The dir is keyed by a host CPU fingerprint: XLA:CPU AOT results compiled on
-# a machine with different vector extensions ABORT (SIGILL) when loaded — a
-# cache carried across driver rounds on heterogeneous hosts did exactly that.
-import hashlib
+# The dir is keyed by a host CPU fingerprint, and the crash-heal + pid
+# sentinel logic lives in utils/compile_cache.py — SHARED with launchers,
+# bench, and tools, which write the same dir: every writer claims a
+# sentinel, or it would be invisible to the healer (its crashes never
+# heal) and unprotected from it (a heal could rmtree under it).
+from nanorlhf_tpu.utils.compile_cache import (  # noqa: E402
+    enable_compilation_cache,
+    sentinel_path,
+)
 
-
-def _host_fingerprint() -> str:
-    # the jax/jaxlib version pair belongs in the key: XLA:CPU AOT results
-    # embed version-dependent target tuning (+prefer-no-gather/scatter et
-    # al.), so entries written by a different jaxlib merely *warn* about a
-    # machine-feature mismatch and then execute differently (observed: a
-    # carried-over cache flipped sampled tokens on this host)
-    try:
-        from importlib.metadata import version
-
-        ver = f"{version('jax')}-{version('jaxlib')}"
-    except Exception:
-        ver = "unknown"
-    try:
-        with open("/proc/cpuinfo") as f:
-            content = f.read()
-        for key in ("flags", "Features"):  # x86 / aarch64 spellings
-            for line in content.splitlines():
-                if line.startswith(key):
-                    return hashlib.sha1(
-                        (ver + line).encode()
-                    ).hexdigest()[:12]
-        # unknown layout: hash the whole thing (may over-rotate the cache on
-        # per-boot fields, but never under-distinguishes vector extensions)
-        return hashlib.sha1((ver + content).encode()).hexdigest()[:12]
-    except OSError:
-        import platform
-
-        key = f"{ver}-{platform.machine()}-{platform.processor()}"
-        return hashlib.sha1(key.encode()).hexdigest()[:12]
-
-
-_cache_dir = os.path.abspath(os.path.join(
-    os.path.dirname(__file__), "..", f".jax_cache_{_host_fingerprint()}"
-))
-
-# Crash healing: a suite process that dies hard (SIGKILL mid-write, native
-# abort) can leave a corrupt cache entry that SIGABRTs every later run at
-# load time (observed). Sentinels mark suites in progress — but they must be
-# PID-AWARE: the naive "sentinel exists → previous run crashed → wipe"
-# logic wiped the cache out from under a CONCURRENT suite when two pytest
-# processes overlapped (observed: the live run then died on torn cache
-# state, which planted the next crash sentinel — a self-sustaining failure).
-# Rules: a sentinel whose pid is dead marks a crash; wipe only when a crash
-# marker exists AND no live suite holds the cache.
-os.makedirs(_cache_dir, exist_ok=True)
-
-
-def _pid_alive(pid: int) -> bool:
-    if pid <= 0:
-        # a corrupt/empty sentinel parses to -1; os.kill(-1, 0) signals the
-        # whole process group and SUCCEEDS — treat nonpositive pids as dead
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except PermissionError:
-        return True  # alive, owned by another user — must NOT wipe under it
-    except (ProcessLookupError, ValueError, OSError):
-        return False
-
-
-import glob
-
-_saw_crash, _saw_live = False, False
-for _f in glob.glob(os.path.join(_cache_dir, ".suite_in_progress*")):
-    try:
-        _pid = int(open(_f).read().strip() or -1)
-    except (OSError, ValueError):
-        _pid = -1
-    if _pid_alive(_pid):
-        _saw_live = True
-    else:
-        _saw_crash = True
-        try:
-            os.remove(_f)
-        except OSError:
-            pass
-if _saw_crash and not _saw_live:
-    import shutil
-
-    shutil.rmtree(_cache_dir, ignore_errors=True)
-    os.makedirs(_cache_dir, exist_ok=True)
-_sentinel = os.path.join(_cache_dir, f".suite_in_progress.{os.getpid()}")
-with open(_sentinel, "w") as _f:
-    _f.write(str(os.getpid()))
-
-try:
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    # persist even sub-second compiles: tiny-model suites are made of them
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-except Exception:
-    pass  # older jax without the persistent cache — suite still runs
+_cache_dir = enable_compilation_cache()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
 def pytest_sessionfinish(session, exitstatus):
-    try:
-        os.remove(_sentinel)
-    except OSError:
-        pass
+    # heal_and_claim's atexit hook also removes the sentinel; doing it at
+    # session end (before interpreter exit) just shrinks the claim window
+    if _cache_dir is not None:
+        try:
+            os.remove(sentinel_path(_cache_dir))
+        except OSError:
+            pass
 
 
 @pytest.fixture

@@ -117,6 +117,10 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from nanorlhf_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache()  # warm-start repeat sessions (VERDICT r4 #2)
+
     from nanorlhf_tpu.core import init_params
     from nanorlhf_tpu.data import ToyTokenizer, PromptDataset
     from nanorlhf_tpu.data.datasets import encode_texts, _left_pad

@@ -2,29 +2,19 @@
 # One-command silicon session (VERDICT r3 #1): run the moment the axon
 # tunnel is up. Each step is ONE jax process (single TPU claim); steps run
 # sequentially with a socket preflight in between so a dead relay skips
-# cleanly instead of hanging a claim. Outputs land in /tmp/silicon_r4/.
+# cleanly instead of hanging a claim. Outputs land in $OUT (default
+# /tmp/silicon_r5/).
 #
 #   bash tools/silicon_session.sh            # full session
 #   STEPS=bench bash tools/silicon_session.sh
 set -u
 cd "$(dirname "$0")/.."
-OUT=/tmp/silicon_r4
+OUT="${OUT:-/tmp/silicon_r5}"
 mkdir -p "$OUT"
 STEPS="${STEPS:-ablate bench learn drift}"
 
 alive() {
-  python3 - <<'EOF'
-import socket, sys
-for port in (8082, 8092, 8102, 8112):
-    s = socket.socket(); s.settimeout(3)
-    try:
-        s.connect(("127.0.0.1", port)); sys.exit(0)
-    except OSError:
-        pass
-    finally:
-        s.close()
-sys.exit(1)
-EOF
+  python3 tools/tunnel_alive.py  # single source of truth for relay ports
 }
 
 run_step() {  # name, timeout_s, command...

@@ -1,0 +1,30 @@
+"""Axon relay liveness probe — THE one place the relay port set lives.
+
+Exit 0 when any relay port accepts a TCP connection, 1 otherwise. Plain
+sockets only: a jax probe against a dead relay hangs ~40 min and can wedge
+the tunnel. Used by tools/silicon_session.sh, tools/tunnel_watch.sh, and
+bench.py (which imports RELAY_PORTS).
+"""
+
+import socket
+import sys
+
+RELAY_PORTS = (8082, 8092, 8102, 8112)
+
+
+def alive(timeout: float = 3.0) -> bool:
+    for port in RELAY_PORTS:
+        s = socket.socket()
+        s.settimeout(timeout)
+        try:
+            s.connect(("127.0.0.1", port))
+            return True
+        except OSError:
+            pass
+        finally:
+            s.close()
+    return False
+
+
+if __name__ == "__main__":
+    sys.exit(0 if alive() else 1)

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Poll the axon relay ports; the moment one accepts, fire the full silicon
+# session (ablate -> bench -> learn -> drift). Designed to run in the
+# background for an entire round: plain-socket probes only (a jax probe on a
+# dead relay hangs ~40 min and can wedge the tunnel).
+#
+#   bash tools/tunnel_watch.sh   # blocks until the tunnel appears, runs once
+set -u
+cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/silicon_r5}"
+mkdir -p "$OUT"
+POLL="${POLL:-20}"
+
+alive() {
+  python3 tools/tunnel_alive.py  # single source of truth for relay ports
+}
+
+echo "watch start $(date +%H:%M:%S), polling every ${POLL}s" >> "$OUT/watch.log"
+n=0
+while ! alive; do
+  sleep "$POLL"
+  n=$((n + 1))
+  if [ $((n % 30)) -eq 0 ]; then
+    echo "still down after $((n * POLL))s $(date +%H:%M:%S)" >> "$OUT/watch.log"
+  fi
+done
+echo "tunnel UP $(date +%H:%M:%S) — settling 20s then starting session" >> "$OUT/watch.log"
+sleep 20
+OUT="$OUT" bash tools/silicon_session.sh >> "$OUT/watch.log" 2>&1
+echo "session complete $(date +%H:%M:%S)" >> "$OUT/watch.log"
