@@ -1,0 +1,78 @@
+"""Unit tests for the learning-curve harness reward functions
+(tools/learning_run.py): the shaped curriculum reward and the r1-contract
+binary reward the phase-2 starvation experiment swaps in.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from learning_run import build_corpus, make_binary_reward, make_reward  # noqa: E402
+
+EOS = "</s>"
+
+
+def _prompt(q):
+    return f"<user> {q} <assistant>"
+
+
+def test_binary_reward_is_binary():
+    q = "What is 2 plus 3? Put the answer in \\boxed{}."
+    fn = make_binary_reward({q: "5"})
+    cases = [
+        (_prompt(q) + " \\boxed{5} " + EOS, 1.0),       # exact → 1
+        (_prompt(q) + " \\boxed{ 5 } " + EOS, 1.0),     # whitespace stripped
+        (_prompt(q) + " \\boxed{6} " + EOS, 0.0),       # wrong → 0
+        (_prompt(q) + " the answer is 5 " + EOS, 0.0),  # unboxed → 0
+        (_prompt(q) + " 5 5 5 5 5", 0.0),               # digits alone → 0
+    ]
+    out = fn([s for s, _ in cases], EOS)
+    np.testing.assert_array_equal(out, [e for _, e in cases])
+
+
+def test_binary_reward_no_partial_credit():
+    """Unlike the shaped reward, format alone must score zero."""
+    q = "What is 10 plus 1? Put the answer in \\boxed{}."
+    shaped = make_reward({q: "11"})
+    binary = make_binary_reward({q: "11"})
+    boxed_wrong = _prompt(q) + " \\boxed{99} " + EOS
+    assert shaped([boxed_wrong], EOS)[0] > 0.0   # format credit exists
+    assert binary([boxed_wrong], EOS)[0] == 0.0  # none here
+
+
+def test_shaped_reward_components():
+    q = "What is 4 plus 4? Put the answer in \\boxed{}."
+    fn = make_reward({q: "8"})
+    # digit-density only
+    digits_only = _prompt(q) + " 1 2 3 4"
+    r_digits = fn([digits_only], EOS)[0]
+    assert 0.9 <= r_digits <= 1.0  # 4/4 digit tokens
+    # + boxed + correct + eos stacks toward the max
+    full = _prompt(q) + " \\boxed{8} " + EOS
+    r_full = fn([full], EOS)[0]
+    assert r_full > r_digits
+    assert r_full >= 1.5  # 0.5 format + 1.0 correct + 0.25 eos at least
+
+
+def test_shaped_scores_response_only():
+    """Prompt digits must not leak into the density term."""
+    q = "What is 40 plus 41? Put the answer in \\boxed{}."
+    fn = make_reward({q: "81"})
+    no_digit_resp = _prompt(q) + " hello world"
+    assert fn([no_digit_resp], EOS)[0] == 0.0
+
+
+def test_build_corpus_answers_consistent():
+    class Tok:  # build_corpus only threads the tokenizer through
+        pass
+
+    texts, answers = build_corpus(Tok(), 64, seed=3)
+    assert len(texts) == 64
+    for t in texts:
+        assert t in answers
+        a, b = [int(x) for x in t.split("?")[0].split() if x.isdigit()]
+        assert answers[t] == str(a + b)

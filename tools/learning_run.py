@@ -23,14 +23,26 @@ repo's answer to the reference's reward-curve evidence at a scale the
 hardware budget allows. Run on the TPU (default env) or CPU
 (`PYTHONPATH= JAX_PLATFORMS=cpu LEARN_MODEL=tiny`).
 
-Env knobs: LEARN_UPDATES (30), LEARN_MODEL (small8m | tiny), LEARN_PROMPTS
-(32 per update), LEARN_RESPONSE (64), LEARN_LR (1e-2), LEARN_OUT
-(docs/artifacts). LR note: from-scratch models need orders more than the
-fine-tuning 6e-6, but too hot COLLAPSES the policy — identical samples →
-zero group advantages → the sparse filter skips the update. Measured on
-CPU: tiny (0.1M) wants 2e-2 (3e-4 is flat noise); small8m (2.9M) at 2e-2
-collapses (33/40 updates skipped), at 8e-3 climbs cleanly 0.15 → 0.66
-over 40 updates with zero skips. Default 8e-3.
+A second phase (`LEARN_BINARY_UPDATES > 0`) then SWAPS the reward to the
+r1-style BINARY one — 1.0 iff the boxed answer is exactly right, else 0.0,
+nothing in between (`examples/r1-v0/grpo_r1.py` reward contract) — and
+keeps training the same policy. This is the regime the reference's 1.5B
+evidence lives in: most GRPO groups score identically (all-wrong or
+all-right) and carry zero advantage, so the sparse filter starves; the
+phase records skip counts and whether binary accuracy still climbs from
+the shaped-phase policy. A from-scratch policy straight into binary would
+be flat forever (never emits \\boxed), which is why the shaped phase runs
+first — the curriculum makes the binary regime reachable on this
+hardware budget.
+
+Env knobs: LEARN_UPDATES (30), LEARN_BINARY_UPDATES (0), LEARN_MODEL
+(small8m | tiny | 1_5b), LEARN_PROMPTS (32 per update), LEARN_RESPONSE
+(64), LEARN_LR (8e-3), LEARN_OUT (docs/artifacts). LR note: from-scratch models
+need orders more than the fine-tuning 6e-6, but too hot COLLAPSES the
+policy — identical samples → zero group advantages → the sparse filter
+skips the update. Measured on CPU: tiny (0.1M) wants 2e-2 (3e-4 is flat
+noise); small8m (2.9M) at 2e-2 collapses (33/40 updates skipped), at 8e-3
+climbs cleanly 0.15 → 0.66 over 40 updates with zero skips. Default 8e-3.
 """
 
 from __future__ import annotations
@@ -51,6 +63,13 @@ def model_config(name: str):
 
     if name == "tiny":
         return ModelConfig.qwen2_tiny(vocab_size=512)
+    if name == "1_5b":
+        # flagship GEOMETRY (hidden/layers/heads of Qwen2-1.5B) at the toy
+        # 512 vocab — the silicon learning-curve shape. Vocab must stay 512:
+        # the digit-token share of the toy tokenizer sets the reward's base
+        # rate, and at real-vocab sizes the from-scratch digit density is so
+        # low every group ties at zero and the sparse filter starves.
+        return dataclasses.replace(ModelConfig.qwen2_1_5b(), vocab_size=512)
     # ~4M-param decoder: an order beyond the 336k-param toy of
     # tests/test_learning.py, small enough that ~40 updates fit a tunnel
     # session (or ~20 min of single-core CPU). Vocab stays 512: the toy
@@ -70,6 +89,16 @@ def model_config(name: str):
 _BOXED = re.compile(r"\\boxed\{([^{}]*)\}")
 
 
+def _expected_answer(s: str, answers_by_prompt: dict):
+    """Ground truth for the prompt embedded in decoded sample `s` (first
+    prompt-substring match wins) — the ONE matching rule both rewards share,
+    so decode-round-trip edge fixes can't diverge the two phases."""
+    for p, a in answers_by_prompt.items():
+        if p in s:
+            return a
+    return None
+
+
 def make_reward(answers_by_prompt: dict):
     """Shaped r1-style reward (see module docstring). `answers_by_prompt`
     maps the prompt text (sans padding) to the ground-truth answer string."""
@@ -86,16 +115,29 @@ def make_reward(answers_by_prompt: dict):
             m = _BOXED.search(resp)
             if m:
                 r += 0.5
-                want = None
-                for p, a in answers_by_prompt.items():
-                    if p in s:
-                        want = a
-                        break
+                want = _expected_answer(s, answers_by_prompt)
                 if want is not None and m.group(1).strip() == want:
                     r += 1.0
             if eos_token in s:
                 r += 0.25
             out.append(r)
+        return np.asarray(out, np.float32)
+
+    return reward
+
+
+def make_binary_reward(answers_by_prompt: dict):
+    """r1-contract binary reward: 1.0 iff the \\boxed answer is exactly the
+    ground truth, else 0.0 — no format shaping, no partial credit. The
+    sparse-filter starvation regime (all-same groups carry zero advantage)."""
+
+    def reward(pmt_and_responses, eos_token):
+        out = []
+        for s in pmt_and_responses:
+            m = _BOXED.search(s.split("<assistant>")[-1])
+            want = _expected_answer(s, answers_by_prompt) if m else None
+            out.append(1.0 if (want is not None
+                               and m.group(1).strip() == want) else 0.0)
         return np.asarray(out, np.float32)
 
     return reward
@@ -114,6 +156,19 @@ def build_corpus(tok, n: int, seed: int):
 
 
 def main():
+    import signal
+
+    # the silicon session bounds this run with coreutils `timeout` (SIGTERM)
+    # — convert it to an exception so the artifact still gets written from
+    # whatever updates completed (a killed run losing its whole curve is the
+    # worst outcome on a flaky tunnel). Installed BEFORE the compile-cache
+    # claim so its SIGTERM chain defers to this one; its sentinel is then
+    # cleaned by atexit on the resulting clean exit.
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt("SIGTERM")
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     import jax
     import jax.numpy as jnp
 
@@ -129,6 +184,7 @@ def main():
     from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
 
     updates = int(os.environ.get("LEARN_UPDATES", 30))
+    binary_updates = int(os.environ.get("LEARN_BINARY_UPDATES", 0))
     model = os.environ.get("LEARN_MODEL", "small8m")
     prompts = int(os.environ.get("LEARN_PROMPTS", 32))
     resp = int(os.environ.get("LEARN_RESPONSE", 64))
@@ -172,7 +228,7 @@ def main():
         per_device_train_batch_size=max(1, prompts // len(jax.devices())),
         gradient_accumulation_steps=1,
         num_mini_batches=1,
-        total_episodes=updates
+        total_episodes=(updates + binary_updates)
         * max(1, prompts // len(jax.devices())) * len(jax.devices()) * 4,
         use_lora=False,                  # full FT: random init has no base
         gradient_checkpointing=True,
@@ -183,9 +239,54 @@ def main():
     )
     trainer = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset,
                                 make_reward(answers))
-    state = trainer.train(num_updates=updates)
+    interrupted = None
+    shaped_steps = None
+    shaped_skips = 0
+    binary_stats = None
+    try:
+        state = trainer.train(num_updates=updates)
+        shaped_steps = state["global_step"]
+        shaped_skips = state["rollouts"] - shaped_steps
+        if binary_updates > 0:
+            # PHASE 2: same policy, same trainer — only the reward becomes
+            # the r1 binary contract. The sparse filter now sees all-same
+            # groups (zero advantage) whenever a prompt is uniformly
+            # failed/solved; skipped updates consume a rollout without
+            # stepping, which is exactly the starvation the 1.5B regime
+            # exhibits.
+            trainer.reward_func = make_binary_reward(answers)
+            state = trainer.train(num_updates=binary_updates)
+    except KeyboardInterrupt as e:
+        interrupted = str(e) or "interrupted"
+        state = trainer.state
+        print(f"\n[learning_run] interrupted ({interrupted}) — writing the "
+              f"artifact from {state['global_step']} completed updates")
+        if shaped_steps is None:  # died in phase 1
+            shaped_steps = state["global_step"]
+            shaped_skips = state["rollouts"] - shaped_steps
+            binary_updates = 0
+    if binary_updates > 0:
+        # derive ATTEMPTED from the rollout counter, not the env knob — an
+        # interrupt mid-phase-2 would otherwise record attempts that never
+        # ran, making the committed skip-rate internally inconsistent
+        binary_attempted = state["rollouts"] - (shaped_steps + shaped_skips)
+        binary_stats = {
+            "updates_attempted": binary_attempted,
+            "updates_stepped": state["global_step"] - shaped_steps,
+            "updates_skipped_by_sparse_filter": (
+                (state["rollouts"] - state["global_step"]) - shaped_skips
+            ),
+        }
 
-    rows = [json.loads(l) for l in open(os.path.join(run_dir, "metrics.jsonl"))]
+    # tolerate a torn trailing line: the SIGTERM→KeyboardInterrupt can land
+    # inside the logger's write, and the recovery path must not lose the
+    # whole curve to one malformed row
+    rows = []
+    for line in open(os.path.join(run_dir, "metrics.jsonl")):
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
     series = [
         {
             "step": r["step"],
@@ -193,16 +294,22 @@ def main():
             "entropy": round(r.get("objective/entropy_old", 0.0), 3),
             # response-length growth — the reference's len.png evidence
             "resp_len": round(r.get("eval_response_length", 0.0), 2),
+            # steps logged after the swap carry the binary phase marker
+            "phase": "binary" if r["step"] > shaped_steps else "shaped",
         }
         for r in rows
         if "eval_objective/scores_old" in r
     ]
     os.makedirs(out_dir, exist_ok=True)
-    first = np.mean([s["score"] for s in series[:3]]) if series else 0.0
-    last = np.mean([s["score"] for s in series[-3:]]) if series else 0.0
+    shaped_series = [s for s in series if s["phase"] == "shaped"]
+    bin_series = [s for s in series if s["phase"] == "binary"]
+    first = np.mean([s["score"] for s in shaped_series[:3]]) if shaped_series else 0.0
+    last = np.mean([s["score"] for s in shaped_series[-3:]]) if shaped_series else 0.0
     artifact = {
         "what": "sparse-GRPO (r1 path) reward curve, shaped math-format "
-                "reward, from-scratch policy",
+                "reward, from-scratch policy"
+                + (" + binary-reward phase (r1 contract)" if binary_stats
+                   else ""),
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "model": model,
@@ -213,12 +320,21 @@ def main():
         "reward_last3_avg": round(float(last), 4),
         "series": series,
     }
-    path = os.path.join(out_dir, "learning_curve_r4.json")
+    if binary_stats:
+        b_first = np.mean([s["score"] for s in bin_series[:3]]) if bin_series else 0.0
+        b_last = np.mean([s["score"] for s in bin_series[-3:]]) if bin_series else 0.0
+        binary_stats["binary_first3_avg"] = round(float(b_first), 4)
+        binary_stats["binary_last3_avg"] = round(float(b_last), 4)
+        artifact["binary_phase"] = binary_stats
+    if interrupted:
+        artifact["interrupted"] = interrupted
+    path = os.path.join(out_dir, "learning_curve_r5.json")
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
-    print(f"\nwrote {path}: reward {first:.3f} -> {last:.3f} over "
-          f"{state['global_step']} updates ({n_params/1e6:.1f}M params, "
-          f"{jax.default_backend()})")
+    print(f"\nwrote {path}: shaped reward {first:.3f} -> {last:.3f} over "
+          f"{shaped_steps} updates ({n_params/1e6:.1f}M params, "
+          f"{jax.default_backend()})"
+          + (f"; binary phase {binary_stats}" if binary_stats else ""))
 
 
 if __name__ == "__main__":

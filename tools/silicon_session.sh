@@ -35,7 +35,8 @@ for s in $STEPS; do
   case $s in
     ablate) run_step ablate 2400 python tools/ablate_decode.py ;;
     bench)  run_step bench 4800 env BENCH_ATTEMPT_TIMEOUT=4300 python bench.py ;;
-    learn)  run_step learn 3600 env LEARN_UPDATES=30 python tools/learning_run.py ;;
+    learn)  run_step learn 3600 env LEARN_MODEL=1_5b LEARN_UPDATES=25 \
+                LEARN_BINARY_UPDATES=15 python tools/learning_run.py ;;
     drift)  run_step drift 1800 python tools/capture_drift.py ;;
   esac
 done
