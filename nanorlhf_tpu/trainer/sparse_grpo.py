@@ -375,6 +375,16 @@ class SparseGRPOTrainer(RLTrainer):
             kept_frac = len(nz) / max(batch_size, 1)
             if len(nz) == 0:
                 print(f"[sparse-grpo] update {update}: all advantages zero, skipping")
+                # a metrics row even for the skip (the reference logs
+                # nothing here): with sparse/binary rewards, WHY training
+                # starves matters — raw_score_mean 0 = uniformly failed,
+                # high = uniformly solved; both give zero group advantage.
+                # Keys are skip-scoped so consumers keyed on the
+                # eval_objective/* step metrics are unaffected.
+                self.logger.log(self.state["global_step"], self.state["episode"], {
+                    "sparse_skip/raw_score_mean": mean_raw_score,
+                    "sparse_skip/rollout_index": self.state["rollouts"],
+                })
                 continue
             scores, queries_f, responses_f = scores[nz], queries[nz], responses[nz]
             if captured_lp is not None:

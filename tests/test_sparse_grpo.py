@@ -88,9 +88,17 @@ def test_sparse_grpo_all_zero_rewards_skips_update(tmp_path):
         gradient_checkpointing=False, mesh=MeshConfig(-1, 1, 1), save_steps=0,
     )
     reward = make_r1_reward(dict(train_qa), use_subprocess=False)
+    cfg.report_to = "jsonl"
     trainer = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, reward)
     state = trainer.train()  # all updates skipped, but loop completes
     assert state["episode"] == 8
+    # skipped updates still leave a metrics row recording the raw score
+    # (distinguishes starved-at-zero from starved-solved regimes)
+    skip_rows = [json.loads(l)
+                 for l in open(tmp_path / "r0" / "metrics.jsonl")
+                 if "sparse_skip/raw_score_mean" in l]
+    assert len(skip_rows) == state["rollouts"] - state["global_step"] > 0
+    assert all(r["sparse_skip/raw_score_mean"] == 0.0 for r in skip_rows)
 
 
 def test_sparse_grpo_sampler_capture(tmp_path):

@@ -132,7 +132,7 @@ def main():
                 "lever": name, "response_length": resp, "rows": n_rows,
                 "sec_steady": round(steady, 3), "compile_sec": round(times[0], 1),
                 "decode_tokens_per_sec": round(toks, 1),
-            }))
+            }), flush=True)
 
     base_key = ("approx_topk", lengths[-1])
     # n4_* levers decode rows×4 physical rows — their raw tokens/s scales
@@ -159,7 +159,7 @@ def main():
             summary[f"n4_shared_speedup_vs_repeat@{resp}"] = round(
                 results[a] / results[b], 3
             )
-    print(json.dumps(summary))
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

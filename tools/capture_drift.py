@@ -75,7 +75,7 @@ def main():
         "per_update": [round(d, 6) for d in drifts],
         "mean": round(float(np.mean(drifts)), 6) if drifts else None,
         "max": round(float(np.max(drifts)), 6) if drifts else None,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -265,11 +265,12 @@ def main():
             shaped_steps = state["global_step"]
             shaped_skips = state["rollouts"] - shaped_steps
             binary_updates = 0
+    shaped_rollouts = shaped_steps + shaped_skips
     if binary_updates > 0:
         # derive ATTEMPTED from the rollout counter, not the env knob — an
         # interrupt mid-phase-2 would otherwise record attempts that never
         # ran, making the committed skip-rate internally inconsistent
-        binary_attempted = state["rollouts"] - (shaped_steps + shaped_skips)
+        binary_attempted = state["rollouts"] - shaped_rollouts
         binary_stats = {
             "updates_attempted": binary_attempted,
             "updates_stepped": state["global_step"] - shaped_steps,
@@ -291,7 +292,7 @@ def main():
         {
             "step": r["step"],
             "score": round(r.get("eval_objective/scores_old", 0.0), 4),
-            "entropy": round(r.get("objective/entropy_old", 0.0), 3),
+            "entropy": round(r.get("policy/entropy_avg_new", 0.0), 3),
             # response-length growth — the reference's len.png evidence
             "resp_len": round(r.get("eval_response_length", 0.0), 2),
             # steps logged after the swap carry the binary phase marker
@@ -303,6 +304,19 @@ def main():
     os.makedirs(out_dir, exist_ok=True)
     shaped_series = [s for s in series if s["phase"] == "shaped"]
     bin_series = [s for s in series if s["phase"] == "binary"]
+    # skip rows (sparse_skip/*, logged by the trainer when every group ties):
+    # raw_score_mean distinguishes starved-at-zero (uniformly failed) from
+    # starved-solved (uniformly correct) — both carry zero group advantage
+    skip_raw = [
+        {"rollout": r["sparse_skip/rollout_index"],
+         "raw_score_mean": round(r["sparse_skip/raw_score_mean"], 4)}
+        for r in rows if "sparse_skip/raw_score_mean" in r
+    ]
+    # rollout_index is the 1-based CONSUMED count (RolloutStream sets
+    # rollouts = index + 1), so the last shaped-phase skip carries exactly
+    # shaped_rollouts — strictly-greater keeps its shaped-scale score out
+    # of the binary average
+    bin_skip_raw = [s for s in skip_raw if s["rollout"] > shaped_rollouts]
     first = np.mean([s["score"] for s in shaped_series[:3]]) if shaped_series else 0.0
     last = np.mean([s["score"] for s in shaped_series[-3:]]) if shaped_series else 0.0
     artifact = {
@@ -325,6 +339,15 @@ def main():
         b_last = np.mean([s["score"] for s in bin_series[-3:]]) if bin_series else 0.0
         binary_stats["binary_first3_avg"] = round(float(b_first), 4)
         binary_stats["binary_last3_avg"] = round(float(b_last), 4)
+        if bin_skip_raw:
+            means = [s["raw_score_mean"] for s in bin_skip_raw]
+            binary_stats["skipped_raw_score_mean_avg"] = round(
+                float(np.mean(means)), 4
+            )
+            binary_stats["starvation_mode"] = (
+                "uniformly_failed" if np.mean(means) < 0.5
+                else "uniformly_solved"
+            )
         artifact["binary_phase"] = binary_stats
     if interrupted:
         artifact["interrupted"] = interrupted

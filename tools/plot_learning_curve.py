@@ -1,0 +1,111 @@
+"""Render docs/artifacts/learning_curve_r5.json as a PNG — the repo's
+analogue of the reference's reward-curve evidence (`docs/perf.png`,
+`examples/r1-v0/len.png`).
+
+Chart method (dataviz): change-over-time → line chart; one y-axis per
+panel (score and response length are different measures → two stacked
+panels, never dual-axis); categorical hues by phase identity in fixed
+slot order (slot 1 blue = shaped, slot 2 orange = binary — the validated
+reference palette's adjacent pair, worst CVD ΔE 9.1 / normal 19.6 on the
+light surface per its documentation; no JS runtime in this image to
+re-run the validator, so the documented-validated values are used
+verbatim); 2px lines, recessive grid, direct phase labels + legend,
+text in ink tokens not series colors.
+
+Usage: python tools/plot_learning_curve.py [artifact.json] [out.png]
+(no jax; matplotlib + stdlib only)
+"""
+
+import json
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+INK2 = "#52514e"
+GRID = "#e5e4e0"
+SHAPED = "#2a78d6"  # categorical slot 1 (blue)
+BINARY = "#eb6834"  # categorical slot 2 (orange)
+
+
+def main():
+    src = sys.argv[1] if len(sys.argv) > 1 else "docs/artifacts/learning_curve_r5.json"
+    out = sys.argv[2] if len(sys.argv) > 2 else "docs/artifacts/learning_curve_r5.png"
+    a = json.load(open(src))
+    series = a["series"]
+    shaped = [s for s in series if s.get("phase", "shaped") == "shaped"]
+    binary = [s for s in series if s.get("phase") == "binary"]
+
+    fig, (ax1, ax2) = plt.subplots(
+        2, 1, figsize=(8.4, 5.6), sharex=True,
+        gridspec_kw={"height_ratios": [2.1, 1]},
+    )
+    fig.patch.set_facecolor(SURFACE)
+
+    for ax in (ax1, ax2):
+        ax.set_facecolor(SURFACE)
+        ax.grid(True, color=GRID, linewidth=0.8, zorder=0)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        for side in ("left", "bottom"):
+            ax.spines[side].set_color(INK2)
+        ax.tick_params(colors=INK2, labelsize=9)
+
+    ax1.plot([s["step"] for s in shaped], [s["score"] for s in shaped],
+             color=SHAPED, linewidth=2, zorder=3, label="shaped reward")
+    if binary:
+        ax1.plot([s["step"] for s in binary], [s["score"] for s in binary],
+                 color=BINARY, linewidth=2, zorder=3,
+                 label="binary reward (r1 contract)")
+    bp = a.get("binary_phase")
+    boundary = max((s["step"] for s in shaped), default=0)
+    if bp:
+        ax1.axvline(boundary + 0.5, color=INK2, linewidth=1,
+                    linestyle=(0, (4, 3)), zorder=2)
+        note = (f"binary phase: {bp['updates_stepped']} stepped / "
+                f"{bp['updates_skipped_by_sparse_filter']} skipped\n"
+                "(sparse filter: all-same groups carry zero advantage)")
+        ax1.annotate(note, xy=(boundary + 1, 0.04),
+                     xycoords=("data", "axes fraction"),
+                     fontsize=8.5, color=INK2, va="bottom")
+    # direct label on the shaped series end (selective, not every point)
+    if shaped:
+        last = shaped[-1]
+        ax1.annotate(f"{last['score']:.2f}", xy=(last["step"], last["score"]),
+                     xytext=(4, 2), textcoords="offset points",
+                     fontsize=9, color=INK, fontweight="bold")
+    ax1.set_ylabel("mean rollout score", color=INK, fontsize=10)
+    if binary:  # one series needs no legend box — the title names it
+        ax1.legend(loc="upper left", frameon=False, fontsize=9,
+                   labelcolor=INK2)
+    n_m = a["n_params"] / 1e6
+    ax1.set_title(
+        f"sparse GRPO (r1 path), from-scratch {n_m:.1f}M policy — "
+        f"{a['backend']} ({a['device_kind']})",
+        color=INK, fontsize=11, loc="left", pad=10,
+    )
+
+    # phase colors must match the top panel's encoding (color follows the
+    # entity — here the training regime — in both panels)
+    ax2.plot([s["step"] for s in shaped], [s["resp_len"] for s in shaped],
+             color=SHAPED, linewidth=2, zorder=3)
+    if binary:
+        ax2.plot([s["step"] for s in binary], [s["resp_len"] for s in binary],
+                 color=BINARY, linewidth=2, zorder=3)
+    if bp:
+        ax2.axvline(boundary + 0.5, color=INK2, linewidth=1,
+                    linestyle=(0, (4, 3)), zorder=2)
+    ax2.set_ylabel("response len (tok)", color=INK, fontsize=10)
+    ax2.set_xlabel("update", color=INK, fontsize=10)
+
+    fig.tight_layout()
+    fig.savefig(out, dpi=160, facecolor=SURFACE)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
