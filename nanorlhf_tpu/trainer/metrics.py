@@ -52,6 +52,24 @@ class MetricsLogger:
             for k, v in metrics.items():
                 self._tb.add_scalar(k, float(v), step)
 
+    def log_event(self, index: int, metrics: dict):
+        """Out-of-band rows (e.g. sparse-filter skips): stamped with the
+        caller's monotonic index + time but NOT 'episode' — consumers
+        identify training-step rows by the presence of 'episode'
+        (tests/test_resume.py idiom), and TB needs a unique x per record
+        (global_step is frozen across consecutive skips)."""
+        record = {"step": index, "time": time.time()}
+        record.update({k: float(v) for k, v in metrics.items()})
+        print(f"[event {index}] " + " ".join(
+            f"{k}={record[k]:.4g}" for k in sorted(metrics)[:8]
+        ))
+        if self._fh:
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+        if self._tb:
+            for k, v in metrics.items():
+                self._tb.add_scalar(k, float(v), index)
+
     def log_samples(self, step: int, queries: list[str], responses: list[str],
                     scores, limit: int = 5):
         """Console sample table — the rich-table parity

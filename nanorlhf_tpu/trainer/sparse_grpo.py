@@ -379,9 +379,10 @@ class SparseGRPOTrainer(RLTrainer):
                 # nothing here): with sparse/binary rewards, WHY training
                 # starves matters — raw_score_mean 0 = uniformly failed,
                 # high = uniformly solved; both give zero group advantage.
-                # Keys are skip-scoped so consumers keyed on the
-                # eval_objective/* step metrics are unaffected.
-                self.logger.log(self.state["global_step"], self.state["episode"], {
+                # log_event (no 'episode' stamp, rollout-indexed) keeps
+                # step-row consumers and TB x-axes intact across
+                # consecutive skips at a frozen global_step.
+                self.logger.log_event(self.state["rollouts"], {
                     "sparse_skip/raw_score_mean": mean_raw_score,
                     "sparse_skip/rollout_index": self.state["rollouts"],
                 })

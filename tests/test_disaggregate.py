@@ -183,3 +183,47 @@ def test_disagg_sparse_grpo(tmp_path):
     tr = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, noisy_reward)
     state = tr.train(num_updates=1)
     assert state["global_step"] == 1
+
+
+def test_disagg_with_sequence_parallel_training(tmp_path):
+    """The r1 flagship combination: generation on its own devices while the
+    TRAINING mesh runs sequence-parallel (sp=2) scoring/updates — the
+    rollout mesh must not inherit the sp axis (generation is not
+    sequence-sharded) and the sp machinery must see only the train mesh."""
+    from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
+
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(2), jnp.float32)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / "sp"),
+        response_length=8,
+        temperature=1.0,
+        sample_n=2,
+        per_device_train_batch_size=4,
+        gradient_accumulation_steps=1,
+        num_mini_batches=1,
+        kl_coef=0.0,                      # ref-free, the r1 setting
+        sampler_logprob_capture=True,
+        mesh=MeshConfig(2, 1, 1, sp=2),   # 4 train devices, sp=2
+        rollout_devices=4,
+        save_steps=0,
+        report_to="none",
+    )
+    cfg.total_episodes = 16
+
+    def noisy_reward(pmt_and_responses, eos_token):
+        import zlib
+
+        return np.asarray(
+            [(zlib.crc32(s.encode()) % 17) / 17.0 for s in pmt_and_responses],
+            np.float32,
+        )
+
+    dataset = load_prompt_dataset("synthetic:64", tok, max_prompt_len=12)
+    tr = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, noisy_reward)
+    assert tr.mesh.shape["sp"] == 2
+    assert tr.rollout_mesh.shape.get("sp", 1) == 1
+    state = tr.train(num_updates=1)
+    assert state["global_step"] == 1

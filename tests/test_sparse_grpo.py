@@ -99,6 +99,11 @@ def test_sparse_grpo_all_zero_rewards_skips_update(tmp_path):
                  if "sparse_skip/raw_score_mean" in l]
     assert len(skip_rows) == state["rollouts"] - state["global_step"] > 0
     assert all(r["sparse_skip/raw_score_mean"] == 0.0 for r in skip_rows)
+    # event rows must NOT carry 'episode' (the step-row discriminator) and
+    # must be uniquely indexed (TB x-axis across consecutive skips)
+    assert all("episode" not in r for r in skip_rows)
+    steps = [r["step"] for r in skip_rows]
+    assert len(set(steps)) == len(steps)
 
 
 def test_sparse_grpo_sampler_capture(tmp_path):
