@@ -654,6 +654,7 @@ def run_bench(jax, init_error):
         "lora": use_lora,
         "rollout_quant": rollout_quant,
         "rollout_ahead": chosen["rollout_ahead"],
+        "rollout_shared_prefill": chosen["rollout_shared_prefill"],
         "sampler_logprob_capture": chosen["sampler_logprob_capture"],
         "kv_cache_quant": kv_cache_quant,
         "prompts_per_update": episodes_per_update,

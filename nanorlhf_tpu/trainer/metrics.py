@@ -38,29 +38,10 @@ class MetricsLogger:
                 print(f"[metrics] tensorboard unavailable ({type(e).__name__}); "
                       "jsonl only")
 
-    def log(self, step: int, episode: int, metrics: dict):
-        record = {"step": step, "episode": episode, "time": time.time()}
+    def _emit(self, prefix: str, x: int, extra: dict, metrics: dict):
+        record = {"step": x, **extra, "time": time.time()}
         record.update({k: float(v) for k, v in metrics.items()})
-        line = json.dumps(record)
-        print(f"[step {step}] " + " ".join(
-            f"{k}={record[k]:.4g}" for k in sorted(metrics)[:8]
-        ))
-        if self._fh:
-            self._fh.write(line + "\n")
-            self._fh.flush()
-        if self._tb:
-            for k, v in metrics.items():
-                self._tb.add_scalar(k, float(v), step)
-
-    def log_event(self, index: int, metrics: dict):
-        """Out-of-band rows (e.g. sparse-filter skips): stamped with the
-        caller's monotonic index + time but NOT 'episode' — consumers
-        identify training-step rows by the presence of 'episode'
-        (tests/test_resume.py idiom), and TB needs a unique x per record
-        (global_step is frozen across consecutive skips)."""
-        record = {"step": index, "time": time.time()}
-        record.update({k: float(v) for k, v in metrics.items()})
-        print(f"[event {index}] " + " ".join(
+        print(f"[{prefix} {x}] " + " ".join(
             f"{k}={record[k]:.4g}" for k in sorted(metrics)[:8]
         ))
         if self._fh:
@@ -68,7 +49,18 @@ class MetricsLogger:
             self._fh.flush()
         if self._tb:
             for k, v in metrics.items():
-                self._tb.add_scalar(k, float(v), index)
+                self._tb.add_scalar(k, float(v), x)
+
+    def log(self, step: int, episode: int, metrics: dict):
+        self._emit("step", step, {"episode": episode}, metrics)
+
+    def log_event(self, index: int, metrics: dict):
+        """Out-of-band rows (e.g. sparse-filter skips): stamped with the
+        caller's monotonic index + time but NOT 'episode' — consumers
+        identify training-step rows by the presence of 'episode'
+        (tests/test_resume.py idiom), and TB needs a unique x per record
+        (global_step is frozen across consecutive skips)."""
+        self._emit("event", index, {}, metrics)
 
     def log_samples(self, step: int, queries: list[str], responses: list[str],
                     scores, limit: int = 5):
