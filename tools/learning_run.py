@@ -37,7 +37,9 @@ hardware budget.
 
 Env knobs: LEARN_UPDATES (30), LEARN_BINARY_UPDATES (0), LEARN_MODEL
 (small8m | tiny | 1_5b), LEARN_PROMPTS (32 per update), LEARN_RESPONSE
-(64), LEARN_LR (8e-3), LEARN_OUT (docs/artifacts). LR note: from-scratch models
+(64), LEARN_LR (8e-3), LEARN_TEMP (1.0 — hotter keeps exploration alive
+past the format plateau; the entropy collapse at 8e-3/1.0 freezes the
+policy before it ever answers correctly), LEARN_OUT (docs/artifacts). LR note: from-scratch models
 need orders more than the fine-tuning 6e-6, but too hot COLLAPSES the
 policy — identical samples → zero group advantages → the sparse filter
 skips the update. Measured on CPU: tiny (0.1M) wants 2e-2 (3e-4 is flat
@@ -143,12 +145,19 @@ def make_binary_reward(answers_by_prompt: dict):
     return reward
 
 
-def build_corpus(tok, n: int, seed: int):
-    """Arithmetic prompts through the toy chat template + their answers."""
+def build_corpus(tok, n: int, seed: int, max_operand: int = 50):
+    """Arithmetic prompts through the toy chat template + their answers.
+    Addends are drawn from 1..max_operand-1 (EXCLUSIVE upper bound,
+    LEARN_MAX_OPERAND; floored at 2 so the range is never empty): small
+    operands make answers single tokens, so from-scratch exploration can
+    actually hit correctness — the knob that decides whether the binary
+    phase has any signal to find."""
     rng = np.random.default_rng(seed)
+    max_operand = max(2, max_operand)
     texts, answers = [], {}
     for _ in range(n):
-        a, b = int(rng.integers(1, 50)), int(rng.integers(1, 50))
+        a = int(rng.integers(1, max_operand))
+        b = int(rng.integers(1, max_operand))
         q = f"What is {a} plus {b}? Put the answer in \\boxed{{}}."
         texts.append(q)
         answers[q] = str(a + b)
@@ -195,7 +204,10 @@ def main():
     params = init_params(mcfg, jax.random.PRNGKey(0), jnp.bfloat16)
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 
-    texts, answers = build_corpus(tok, 256, seed=0)
+    texts, answers = build_corpus(
+        tok, 256, seed=0,
+        max_operand=int(os.environ.get("LEARN_MAX_OPERAND", 50)),
+    )
     templated = [
         tok.apply_chat_template([{"role": "user", "content": t}],
                                 tokenize=False, add_generation_prompt=True)
@@ -216,7 +228,7 @@ def main():
         exp_name="learning-curve",
         output_dir=run_dir,
         response_length=resp,
-        temperature=1.0,
+        temperature=float(os.environ.get("LEARN_TEMP", 1.0)),
         top_p=0.95,
         rollout_top_k=0,                 # r1 default: exact nucleus
         sample_n=4,
