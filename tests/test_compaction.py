@@ -74,22 +74,18 @@ def test_trainer_compaction_smoke(tmp_path):
     assert state["global_step"] == 2
 
 
-def test_compaction_sharded_matches_unsharded():
-    """Mesh-aware compaction (batch_sharding kwarg): gathered carries are
-    re-laid-out under the caller's batch sharding and the gather target is
-    clamped to a multiple of the batch-axis device count. The token stream
-    must be bit-identical to the unsharded compacted run — sharding is a
-    layout, not a semantics, decision."""
+def _assert_sharded_matches_unsharded(sp: SamplingParams, seed: int):
+    """Run `generate(sp)` unsharded and on a (4,2,1) mesh with a sharded
+    batch; token streams must be bit-identical — sharding is a layout, not
+    a semantics, decision. Shared by the plain and fanout compaction tests."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from nanorlhf_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
     from nanorlhf_tpu.sampler.compaction import _batch_axis_size
 
     mcfg, params, ids, mask = _setup(rows=16)
-    sp = SamplingParams(temperature=1.0, top_p=0.95, max_tokens=24,
-                        compaction_segments=6)
     out_ref = np.asarray(generate(params, mcfg, ids, mask,
-                                  jax.random.PRNGKey(9), sp, EOS, PAD))
+                                  jax.random.PRNGKey(seed), sp, EOS, PAD))
 
     mesh = make_mesh(MeshConfig(4, 2, 1))          # batch spans data*fsdp = 8
     bs = batch_sharding(mesh)
@@ -99,9 +95,33 @@ def test_compaction_sharded_matches_unsharded():
     params_s = jax.device_put(
         params, NamedSharding(mesh, P()))          # replicated params
     out_s = np.asarray(generate(params_s, mcfg, ids_s, mask_s,
-                                jax.random.PRNGKey(9), sp, EOS, PAD,
+                                jax.random.PRNGKey(seed), sp, EOS, PAD,
                                 batch_sharding=bs))
     np.testing.assert_array_equal(out_ref, out_s)
+    return out_ref
+
+
+def test_compaction_sharded_matches_unsharded():
+    """Mesh-aware compaction (batch_sharding kwarg): gathered carries are
+    re-laid-out under the caller's batch sharding and the gather target is
+    clamped to a multiple of the batch-axis device count."""
+    _assert_sharded_matches_unsharded(
+        SamplingParams(temperature=1.0, top_p=0.95, max_tokens=24,
+                       compaction_segments=6),
+        seed=9,
+    )
+
+
+def test_compaction_sharded_fanout_matches():
+    """The trainer's default-on stack composed: shared-prompt-KV fanout
+    (n=4) + compacting decode + a sharded batch — layout decisions (GSPMD
+    placement, gather re-layout) must never leak into sampling."""
+    out = _assert_sharded_matches_unsharded(
+        SamplingParams(temperature=1.0, top_p=0.95, max_tokens=24, n=4,
+                       compaction_segments=6),  # shared_prompt_prefill default
+        seed=5,
+    )
+    assert out.shape[0] == 64  # 16 prompts × 4 samples
 
 
 def test_compaction_with_int8_kv_cache():
