@@ -26,6 +26,8 @@ measured into detail.short_response when the budget allows),
 BENCH_MODEL (1_5b | tiny), BENCH_UPDATES (2),
 BENCH_ATTENTION (xla | pallas | auto), BENCH_LORA (1 | 0),
 BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
+BENCH_ORCH (0 | 1: async rollout orchestrator, docs/ORCHESTRATOR.md),
+BENCH_STALENESS (2: orchestrator max_staleness),
 BENCH_KV_QUANT (0 | 1: int8 KV cache),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
@@ -423,6 +425,8 @@ def run_bench(jax, init_error):
     use_lora = os.environ.get("BENCH_LORA", "1") == "1"
     rollout_quant = "int8" if os.environ.get("BENCH_QUANT", "0") == "1" else "none"
     rollout_ahead = os.environ.get("BENCH_AHEAD", "0") == "1"
+    orchestrator = os.environ.get("BENCH_ORCH", "0") == "1"
+    orch_staleness = int(os.environ.get("BENCH_STALENESS", "2"))
     kv_cache_quant = "int8" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "none"
     # BENCH_SWEEP=1 (default on real TPU): after the baseline, ALSO measure
     # the int8 rollout levers and report the faster config as the headline.
@@ -474,14 +478,25 @@ def run_bench(jax, init_error):
     dataset = load_prompt_dataset(f"synthetic:{max(64, n_prompts * 2)}", tok,
                                   max_prompt_len=64)
 
-    def measure(r_quant, kv_quant, ahead, resp=None, capture=False):
+    def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
+                orchestrator=False, staleness=2):
         """One full config measurement: fresh trainer, warmup update
-        (compile) + n_updates timed. Returns the timing dict."""
+        (compile) + n_updates timed. Returns the timing dict.
+
+        `orchestrator=True` runs the async rollout pipeline
+        (docs/ORCHESTRATOR.md) at `max_staleness=staleness` with
+        truncated-IS correction (capture forced on — it supplies the
+        behavior logprobs). Note the bench's repeated train(num_updates=1)
+        calls are exactly where the orchestrator's cross-call pipelining
+        beats rollout_ahead, whose prefetch never fires inside a
+        single-update train() call — the payload's
+        rollout_train_overlap_frac rows make that visible.
+        """
         resp = response_len if resp is None else resp
         cfg = RLConfig(
             algo=AlgoName.GRPO,
             output_dir="/tmp/nanorlhf_tpu_bench",
-            sampler_logprob_capture=capture,
+            sampler_logprob_capture=capture or orchestrator,
             response_length=resp,
             temperature=0.9,
             sample_n=sample_n,
@@ -492,7 +507,9 @@ def run_bench(jax, init_error):
             kl_coef=0.01,
             use_lora=use_lora,
             rollout_quant=r_quant,
-            rollout_ahead=ahead,
+            rollout_ahead=ahead and not orchestrator,
+            rollout_orchestrator=orchestrator,
+            max_staleness=staleness,
             kv_cache_quant=kv_quant,
             gradient_checkpointing=True,
             mesh=MeshConfig(n_dev, 1, 1),
@@ -504,23 +521,32 @@ def run_bench(jax, init_error):
         trainer = RLTrainer(cfg, mcfg, tok, params, dataset, reward)
         times = []
         phase_snapshot = {}
-        for i in range(n_updates + 1):
-            t0 = time.time()
-            trainer.train(num_updates=1)
-            times.append(time.time() - t0)
-            if i == 0:  # snapshot after warmup: phase split = steady-state
-                phase_snapshot = dict(trainer.timer.cumulative)
+        try:
+            for i in range(n_updates + 1):
+                t0 = time.time()
+                trainer.train(num_updates=1)
+                times.append(time.time() - t0)
+                if i == 0:  # snapshot after warmup: phase split = steady-state
+                    phase_snapshot = dict(trainer.timer.cumulative)
+            overlap = trainer.rollout_overlap_frac()
+        finally:
+            trainer.close()  # join the orchestrator's producer thread
         steady = times[1:] if len(times) > 1 else times
         sec = float(np.mean(steady))
         return {
             "rollout_quant": r_quant,
             "kv_cache_quant": kv_quant,
-            "rollout_ahead": ahead,
+            "rollout_ahead": cfg.rollout_ahead,
+            "rollout_orchestrator": orchestrator,
+            "max_staleness": staleness if orchestrator else None,
             "rollout_shared_prefill": cfg.rollout_shared_prefill,
-            "sampler_logprob_capture": capture,
+            "sampler_logprob_capture": cfg.sampler_logprob_capture,
             "response_length": resp,
             "sec_per_update_steady": round(sec, 3),
             "compile_update_sec": round(times[0], 3),
+            # rollout/train overlap: fraction of generation wall-clock that
+            # ran concurrently with trainer work (orchestrator.OverlapMeter)
+            "rollout_train_overlap_frac": round(overlap, 4),
             # cfg.batch_size (set by finalize inside RLTrainer) is the TRUE
             # episode count per update
             "episodes_per_update": cfg.batch_size,
@@ -531,7 +557,8 @@ def run_bench(jax, init_error):
         }
 
     t_baseline = time.time()
-    chosen = measure(rollout_quant, kv_cache_quant, rollout_ahead)
+    chosen = measure(rollout_quant, kv_cache_quant, rollout_ahead,
+                     orchestrator=orchestrator, staleness=orch_staleness)
     t_baseline = time.time() - t_baseline
     sweep_detail = None
     # the lever config recompiles everything (≈ another baseline's worth of
@@ -574,6 +601,36 @@ def run_bench(jax, init_error):
                 sweep_detail["all_levers_error"] = (
                     f"{type(e).__name__}: {e}"[:300]
                 )
+        # async rollout orchestrator lever (docs/ORCHESTRATOR.md): depth-2
+        # pipelined rollouts with truncated-IS correction. Its
+        # rollout_train_overlap_frac row vs the baseline's (and vs a
+        # BENCH_AHEAD run's) is the pipelining acceptance signal — the
+        # bench's repeated train(num_updates=1) calls are exactly where
+        # rollout_ahead's in-call prefetch never fires but the
+        # orchestrator's producer thread keeps the pipeline warm.
+        if (not orchestrator and isinstance(sweep_detail, dict)
+                and budget - (time.time() - _T0) > 1.2 * t_baseline):
+            try:
+                orch = measure(
+                    chosen["rollout_quant"], chosen["kv_cache_quant"], False,
+                    orchestrator=True, staleness=orch_staleness,
+                )
+                sweep_detail["orchestrator_sec_per_update"] = (
+                    orch["sec_per_update_steady"]
+                )
+                sweep_detail["orchestrator_overlap_frac"] = (
+                    orch["rollout_train_overlap_frac"]
+                )
+                sweep_detail["baseline_overlap_frac"] = (
+                    chosen["rollout_train_overlap_frac"]
+                )
+                if (orch["sec_per_update_steady"]
+                        < chosen["sec_per_update_steady"]):
+                    chosen = orch
+            except Exception as e:
+                sweep_detail["orchestrator_error"] = (
+                    f"{type(e).__name__}: {e}"[:300]
+                )
 
     # secondary short-response point (the r1/r2 rounds' resp-256 shape) so
     # the payload carries BOTH operating points — the resp-1500 headline
@@ -596,6 +653,8 @@ def run_bench(jax, init_error):
                 chosen["rollout_quant"], chosen["kv_cache_quant"],
                 chosen["rollout_ahead"], resp=256,
                 capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
             )
             short_detail = {
                 "response_length": 256,
@@ -654,6 +713,9 @@ def run_bench(jax, init_error):
         "lora": use_lora,
         "rollout_quant": rollout_quant,
         "rollout_ahead": chosen["rollout_ahead"],
+        "rollout_orchestrator": chosen["rollout_orchestrator"],
+        "max_staleness": chosen["max_staleness"],
+        "rollout_train_overlap_frac": chosen["rollout_train_overlap_frac"],
         "rollout_shared_prefill": chosen["rollout_shared_prefill"],
         "sampler_logprob_capture": chosen["sampler_logprob_capture"],
         "kv_cache_quant": kv_cache_quant,

@@ -170,8 +170,9 @@ def _spmd_call(spmd, fn, args, head_dims):
     over the head axis. Output shards like the first argument. Without this
     GSPMD must treat the inner pallas_call as an opaque custom call and
     all-gathers every operand (see ModelConfig.spmd_mesh)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from nanorlhf_tpu.utils.shardmap_compat import shard_map
 
     mesh, batch, head = spmd
 

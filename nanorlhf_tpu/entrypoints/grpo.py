@@ -8,10 +8,18 @@ from nanorlhf_tpu.entrypoints.common import run
 from nanorlhf_tpu.trainer import AlgoName, RLConfig
 
 
-def build_config(sequence_parallel: int = 1) -> RLConfig:
+def build_config(sequence_parallel: int = 1,
+                 rollout_staleness: int | None = None,
+                 rollout_devices: int = 0) -> RLConfig:
     """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
     update through ring attention with the sequence dim sharded over an sp
-    mesh axis (response_length must divide by it)."""
+    mesh axis (response_length must divide by it).
+
+    `rollout_staleness` (not None) turns on the async rollout orchestrator
+    (docs/ORCHESTRATOR.md) at that max_staleness, with sampler logprob
+    capture so the truncated-IS off-policy correction has the behavior
+    logprobs it needs; pair with `rollout_devices > 0` to give generation
+    its own device group so it truly never waits on the train step."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-v1",
@@ -50,6 +58,12 @@ def build_config(sequence_parallel: int = 1) -> RLConfig:
         from nanorlhf_tpu.parallel import MeshConfig
 
         cfg.mesh = MeshConfig(data=-1, sp=sequence_parallel)
+    if rollout_staleness is not None:
+        cfg.rollout_orchestrator = True
+        cfg.max_staleness = rollout_staleness
+        cfg.sampler_logprob_capture = True  # behavior logprobs for the IS fix
+    if rollout_devices > 0:
+        cfg.rollout_devices = rollout_devices
     return cfg
 
 

@@ -1,0 +1,22 @@
+"""Async rollout orchestration: version-tagged weights, bounded-staleness
+sample queue, producer-thread rollout pipeline (docs/ORCHESTRATOR.md)."""
+
+from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
+from nanorlhf_tpu.orchestrator.sample_queue import (
+    BoundedStalenessQueue,
+    QueuedSample,
+)
+from nanorlhf_tpu.orchestrator.orchestrator import (
+    OverlapMeter,
+    RolloutOrchestrator,
+    note_ready_async,
+)
+
+__all__ = [
+    "BoundedStalenessQueue",
+    "OverlapMeter",
+    "QueuedSample",
+    "RolloutOrchestrator",
+    "VersionedWeightStore",
+    "note_ready_async",
+]

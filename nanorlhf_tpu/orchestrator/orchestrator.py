@@ -1,0 +1,265 @@
+"""RolloutOrchestrator — producer-thread rollout pipeline over the
+version-tagged weight store and the bounded-staleness queue.
+
+Generalizes the trainer's one-step `rollout_ahead` prefetch into a
+configurable pipelined depth (PipelineRL / LlamaRL): a daemon producer
+thread pulls prompt batches, grabs the LATEST published policy snapshot,
+dispatches generation, blocks until the sample is device-ready, and
+enqueues it version-tagged; the trainer consumes via `get()` and publishes
+a new version after every optimizer update. With disaggregated rollout
+devices the producer's generation executes on its own mesh WHILE the
+consumer's scoring/update runs on the train mesh — and, unlike
+rollout_ahead (whose prefetch lives inside one `train()` call), the
+pipeline stays warm across `train(num_updates=1)` invocations.
+
+Determinism contract: the producer is the ONLY consumer of the trainer's
+data iterator, and generation PRNG keys come from the trainer's stateless
+index-keyed stream (`fold_in(base, index)`), so the data and PRNG streams
+are exactly the ones the synchronous trainer would see — the basis of the
+checkpoint/resume journal (docs/ORCHESTRATOR.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from nanorlhf_tpu.orchestrator.sample_queue import (
+    BoundedStalenessQueue,
+    QueuedSample,
+)
+from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
+
+
+def _merge_intervals(ivs: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    out: list[tuple[float, float]] = []
+    for t0, t1 in sorted(ivs):
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _sweep_overlap(gen, busy) -> float:
+    """Σ |gen_i ∩ busy_j| over two merged, sorted interval lists."""
+    overlap, j = 0.0, 0
+    for g0, g1 in gen:
+        while j < len(busy) and busy[j][1] <= g0:
+            j += 1
+        k = j
+        while k < len(busy) and busy[k][0] < g1:
+            overlap += min(g1, busy[k][1]) - max(g0, busy[k][0])
+            k += 1
+    return overlap
+
+
+class OverlapMeter:
+    """Rollout/train overlap accounting from measured wall-clock intervals.
+
+    Producers record generation busy windows [dispatch, device-ready];
+    the consumer records its own busy windows (everything between fetching
+    a sample and asking for the next one — reward, scoring, update).
+    `overlap_fraction()` = |union(gen) ∩ union(busy)| / |union(gen)|: the
+    fraction of generation wall-clock that ran CONCURRENTLY with useful
+    trainer work. 0 for the serial trainer (generation only runs while the
+    consumer waits); → 1 when the pipeline fully hides generation.
+
+    The metric is cumulative over the trainer's lifetime but the interval
+    history is NOT: past `_COMPACT_AT` stored intervals the prefix below a
+    watermark is folded into scalar accumulators (overlap seconds + gen
+    seconds), so a long run pays O(_COMPACT_AT) per reading instead of an
+    ever-growing sweep. The watermark is the minimum of the two streams'
+    latest recorded end-times: both streams record chronologically
+    non-overlapping windows, so every FUTURE interval starts at or after
+    it — clipping both histories at the watermark makes the folded /
+    retained decomposition exact, not an approximation.
+    """
+
+    _COMPACT_AT = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._gen: list[tuple[float, float]] = []
+        self._busy: list[tuple[float, float]] = []
+        self._overlap_acc = 0.0   # folded prefix: overlap seconds
+        self._gen_acc = 0.0       # folded prefix: generation seconds
+
+    def note_gen(self, t0: float, t1: float) -> None:
+        with self._lock:
+            self._gen.append((t0, t1))
+            self._maybe_compact()
+
+    def note_busy(self, t0: float, t1: float) -> None:
+        with self._lock:
+            self._busy.append((t0, t1))
+            self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        # caller holds the lock
+        if len(self._gen) + len(self._busy) < self._COMPACT_AT \
+                or not self._gen or not self._busy:
+            return
+        cutoff = min(self._gen[-1][1], self._busy[-1][1])
+
+        def clip(ivs):
+            below, above = [], []
+            for t0, t1 in ivs:
+                if t1 <= cutoff:
+                    below.append((t0, t1))
+                elif t0 >= cutoff:
+                    above.append((t0, t1))
+                else:  # straddler: split exactly at the watermark
+                    below.append((t0, cutoff))
+                    above.append((cutoff, t1))
+            return below, above
+
+        gen_lo, gen_hi = clip(_merge_intervals(self._gen))
+        busy_lo, busy_hi = clip(_merge_intervals(self._busy))
+        self._overlap_acc += _sweep_overlap(gen_lo, busy_lo)
+        self._gen_acc += sum(t1 - t0 for t0, t1 in gen_lo)
+        self._gen, self._busy = gen_hi, busy_hi
+
+    def overlap_fraction(self) -> float:
+        with self._lock:
+            gen = _merge_intervals(self._gen)
+            busy = _merge_intervals(self._busy)
+            overlap = self._overlap_acc + _sweep_overlap(gen, busy)
+            total = self._gen_acc + sum(t1 - t0 for t0, t1 in gen)
+        if total <= 0.0:
+            return 0.0
+        return min(1.0, max(0.0, overlap / total))
+
+
+def note_ready_async(meter: OverlapMeter, payload, t0: float) -> None:
+    """Record [t0, device-ready] into `meter` without blocking the caller —
+    a daemon waiter thread block_until_ready's the (async-dispatched)
+    payload. Lets the synchronous RolloutStream report honest generation
+    busy windows for the same overlap metric the orchestrator emits."""
+
+    def _wait():
+        try:
+            jax.block_until_ready(payload)
+        except Exception:
+            return  # the consumer surfaces dispatch errors; meter stays silent
+        meter.note_gen(t0, time.time())
+
+    threading.Thread(target=_wait, daemon=True,
+                     name="rollout-ready-watch").start()
+
+
+class RolloutOrchestrator:
+    """Producer thread + version store + bounded-staleness queue.
+
+    `dispatch_fn(index, params_tree) -> payload` pulls the next prompt
+    batch, folds the generation key for `index`, and async-dispatches
+    generation from `params_tree` (a published snapshot — never the live
+    donated training tree). `initial_params` becomes version 0.
+    """
+
+    def __init__(
+        self,
+        dispatch_fn: Callable[[int, dict], dict],
+        initial_params: dict,
+        start_index: int = 0,
+        max_staleness: int = 1,
+        policy: str = "wait",
+        meter: Optional[OverlapMeter] = None,
+        restore: Optional[dict] = None,
+        heartbeat: float = 30.0,
+    ):
+        self.store = VersionedWeightStore()
+        self.store.publish(initial_params)  # version 0
+        self.queue = BoundedStalenessQueue(
+            max_staleness, policy, start_index=start_index
+        )
+        if restore:
+            self.queue.restore_counters(restore)
+        self.meter = meter if meter is not None else OverlapMeter()
+        self.max_staleness = max_staleness
+        self._dispatch_fn = dispatch_fn
+        self._next_index = start_index
+        self._heartbeat = heartbeat
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, daemon=True, name="rollout-producer"
+        )
+        self._thread.start()
+
+    # ---------------------------------------------------------------- #
+    # producer loop
+    # ---------------------------------------------------------------- #
+
+    def _produce(self):
+        try:
+            while not self._stop.is_set():
+                idx = self._next_index
+                if not self.queue.wait_to_produce(idx, self._stop):
+                    break
+                version, tree = self.store.latest()
+                t0 = time.time()
+                payload = self._dispatch_fn(idx, tree)
+                # block HERE (producer thread): the consumer receives
+                # device-ready samples, and [t0, t1] is the true
+                # generation busy window for the overlap meter
+                jax.block_until_ready(payload)
+                t1 = time.time()
+                self.meter.note_gen(t0, t1)
+                self.queue.put(QueuedSample(idx, version, payload, t0, t1))
+                self._next_index += 1
+        except BaseException as e:  # surfaces in the consumer's get()
+            self.queue.fail(e)
+
+    # ---------------------------------------------------------------- #
+    # consumer API (the trainer)
+    # ---------------------------------------------------------------- #
+
+    @property
+    def version(self) -> int:
+        return self.store.version
+
+    def get(self) -> QueuedSample:
+        """Next sample — waits as long as the producer is making progress.
+
+        No hard deadline: a cold-cache first generation can legitimately
+        compile for many minutes (the bench's 1.5B config budgets whole
+        attempts at 2100 s), so the wait only aborts when the producer
+        thread is actually DEAD without having reported an error through
+        `queue.fail()` (which covers every exception path in `_produce`).
+        The heartbeat interval just bounds how often liveness is checked."""
+        while True:
+            try:
+                return self.queue.get(timeout=self._heartbeat)
+            except TimeoutError:
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "rollout producer thread died without reporting an "
+                        "error"
+                    ) from None
+
+    def publish(self, tree: dict) -> int:
+        """Publish a post-update policy snapshot; wakes the producer gate."""
+        v = self.store.publish(tree)
+        self.queue.advance_version(v)
+        return v
+
+    def stats(self) -> dict:
+        # overlap lives on the meter (trainer reads meter.overlap_fraction()
+        # directly) — recomputing the sweep here per update would be waste
+        return {
+            "queue_depth": self.queue.depth(),
+            "dropped": self.queue.dropped,
+            "staleness_counts": dict(self.queue.staleness_counts),
+        }
+
+    def journal(self) -> dict:
+        """Checkpoint payload (trainer_state.json "orchestrator" key)."""
+        return self.queue.journal()
+
+    def close(self, join_timeout: float = 30.0) -> None:
+        self._stop.set()
+        self.queue.advance_version(self.queue.version)  # wake any waiter
+        self._thread.join(timeout=join_timeout)

@@ -1,0 +1,174 @@
+"""Bounded-staleness rollout sample queue (host thread + deque; device
+arrays inside payloads stay sharded — the queue never copies them).
+
+Staleness model (docs/ORCHESTRATOR.md): the policy advances one VERSION per
+optimizer update (`VersionedWeightStore.publish`); a sample generated from
+version v consumed while the policy is at version V has staleness V − v.
+With one publish per consumed sample (the dense trainer), gating the
+producer so rollout i (relative to the queue's start index) waits until
+`version >= i - max_staleness` bounds every consumed sample's staleness at
+`max_staleness` — the PipelineRL/LlamaRL bounded-lag queue.
+
+Overflow policy — production is gated IDENTICALLY in both modes (a sample
+whose dispatch could already exceed the bound would only burn the data/PRNG
+cursor and rollout compute on a result destined for the floor); they differ
+in what happens to a queued sample that goes over-stale anyway, which under
+the normal one-publish-per-consume cadence cannot happen and therefore
+signals an abnormal cadence (the consumer published without consuming —
+e.g. an external weight sync or multi-update schedule):
+- "wait" (default): over-stale samples are still DELIVERED (recorded in the
+  staleness histogram above the bound) — nothing is ever discarded, and the
+  truncated-IS correction absorbs the extra staleness.
+- "drop": `get()` DISCARDS over-stale samples and returns the next fresh
+  one; `dropped` counts the discards.
+
+jax-free on purpose: unit-testable with plain dict payloads.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class QueuedSample:
+    index: int           # rollout index — the data/PRNG cursor position
+    version: int         # policy version the sample was generated from
+    payload: Any         # the rollout dict (sharded device arrays + host data)
+    dispatch_time: float = 0.0
+    ready_time: float = 0.0
+
+
+class BoundedStalenessQueue:
+    def __init__(self, max_staleness: int, policy: str = "wait",
+                 start_index: int = 0):
+        if max_staleness < 0:
+            raise ValueError(f"max_staleness={max_staleness} must be >= 0")
+        if policy not in ("wait", "drop"):
+            raise ValueError(f"staleness policy {policy!r}: wait | drop")
+        self.max_staleness = max_staleness
+        self.policy = policy
+        self.maxsize = max_staleness + 1
+        self._base = start_index     # gate arithmetic is RELATIVE to this
+        self._q: collections.deque[QueuedSample] = collections.deque()
+        self._cond = threading.Condition()
+        self._version = 0            # latest published policy version
+        self._error: Optional[BaseException] = None
+        # ---- metrics (cumulative; resume seeds them from the journal) ----
+        self.dropped = 0
+        self.staleness_counts: dict[int, int] = {}
+
+    # ---------------------------------------------------------------- #
+    # producer side
+    # ---------------------------------------------------------------- #
+
+    def wait_to_produce(self, index: int, stop) -> bool:
+        """Block until rollout `index` may be dispatched; False on stop.
+
+        Gate (both policies): the staleness bound — the version must have
+        reached `index - base - max_staleness` — plus queue capacity. With
+        one publish per consume, a sample admitted here can never exceed
+        the bound at consumption.
+        """
+        with self._cond:
+            while not stop.is_set():
+                gate_open = (
+                    (index - self._base) - self._version <= self.max_staleness
+                )
+                if gate_open and len(self._q) < self.maxsize:
+                    return True
+                self._cond.wait(timeout=0.1)
+            return False
+
+    def put(self, sample: QueuedSample) -> None:
+        with self._cond:
+            self._q.append(sample)
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        """Producer died: wake the consumer with the exception."""
+        with self._cond:
+            self._error = exc
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------------- #
+    # consumer side
+    # ---------------------------------------------------------------- #
+
+    def advance_version(self, version: int) -> None:
+        """The trainer published a new policy version (one per update)."""
+        with self._cond:
+            self._version = version
+            self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None) -> QueuedSample:
+        """Next sample, oldest first; records its staleness in the
+        histogram. Under "drop", over-stale samples are discarded here."""
+        with self._cond:
+            while True:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "rollout producer failed"
+                    ) from self._error
+                if self._q:
+                    s = self._q.popleft()
+                    staleness = self._version - s.version
+                    if (self.policy == "drop"
+                            and staleness > self.max_staleness):
+                        self.dropped += 1
+                        self._cond.notify_all()
+                        continue
+                    self.staleness_counts[staleness] = (
+                        self.staleness_counts.get(staleness, 0) + 1
+                    )
+                    self._cond.notify_all()
+                    return s
+                if not self._cond.wait(timeout=timeout):
+                    raise TimeoutError(
+                        f"no rollout sample after {timeout}s (producer "
+                        "stalled?)"
+                    )
+
+    # ---------------------------------------------------------------- #
+    # introspection / persistence
+    # ---------------------------------------------------------------- #
+
+    @property
+    def version(self) -> int:
+        with self._cond:
+            return self._version
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def journal(self) -> dict:
+        """JSON-able queue state for the checkpoint's trainer_state: the
+        pending (dispatched, unconsumed) indices plus cumulative counters.
+        Pending samples are NOT persisted — on resume they are re-drawn
+        from the consumed-rollout cursor (the index-keyed generation PRNG
+        and deterministic loader reproduce their token streams)."""
+        with self._cond:
+            return {
+                "pending": [s.index for s in self._q],
+                "version": self._version,
+                "dropped": self.dropped,
+                "staleness_counts": {
+                    str(k): v for k, v in self.staleness_counts.items()
+                },
+            }
+
+    def restore_counters(self, journal: dict) -> None:
+        """Seed the cumulative metric counters from a saved journal so
+        dropped/staleness series stay continuous across resume. Version and
+        pending entries are NOT restored — a fresh orchestrator restarts
+        version-relative arithmetic at 0 and re-draws pending samples."""
+        with self._cond:
+            self.dropped = int(journal.get("dropped", 0))
+            self.staleness_counts = {
+                int(k): int(v)
+                for k, v in journal.get("staleness_counts", {}).items()
+            }

@@ -1,0 +1,59 @@
+"""Version-tagged policy weight store for async rollouts.
+
+The synchronous trainer hands the live `self.params` tree to generation.
+With a producer thread generating WHILE the consumer updates, that tree is
+a moving target — worse, the jitted update DONATES its trainable input
+buffers (`trainer._make_update_fn`, donate_argnums), so a generation
+dispatched off the live tree mid-update can read deleted/aliased arrays.
+
+The store decouples the two: the trainer PUBLISHES an immutable snapshot
+after every optimizer update (the caller copies exactly the donation-hazard
+leaves — trainable ones; frozen base weights are safely aliased, see
+`RLTrainer._policy_snapshot`), and rollout workers PULL the latest published
+version without ever blocking the train step. Versions are monotonically
+increasing, starting at 0 for the tree published at construction/creation —
+all staleness arithmetic (`sample_queue`, metrics) is relative to these
+version tags.
+
+Device placement is untouched: published leaves stay sharded jax.Arrays;
+the store is plain host-side bookkeeping (no jax import).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class VersionedWeightStore:
+    """Thread-safe {version -> param tree} holder keeping only the latest.
+
+    `publish(tree)` tags `tree` with the next version and makes it the one
+    `latest()` returns; the previous snapshot is dropped (rollout dispatch
+    always wants the freshest policy — a sample's version tag, not the
+    store, remembers which weights generated it).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._version = -1
+        self._tree: Any = None
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, tree: Any) -> int:
+        """Store `tree` as the new latest snapshot; returns its version."""
+        with self._lock:
+            self._version += 1
+            self._tree = tree
+            return self._version
+
+    def latest(self) -> tuple[int, Any]:
+        """(version, tree) of the newest published snapshot."""
+        with self._lock:
+            if self._version < 0:
+                raise RuntimeError("no weights published yet")
+            return self._version, self._tree

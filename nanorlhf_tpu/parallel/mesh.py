@@ -175,7 +175,15 @@ def split_rollout_devices(devices, k: int):
     prefers WHOLE slices (highest slice_index first) so the rollout mesh's
     own collectives stay on ICI and only the param sync rides DCN; when no
     suffix of whole slices sums to `k` (or on hosts without slice_index,
-    e.g. CPU test meshes) it falls back to the id-ordered tail."""
+    e.g. CPU test meshes) it falls back to the id-ordered tail — which on a
+    MULTI-slice pod either spreads the rollout mesh over several slices
+    (rollout collectives then ride DCN every decode step) or carves the
+    rollout group out of one slice shared with training (train-mesh
+    collectives straddle the cut); both are warned (ADVICE r5).
+    Single-slice hosts warn about nothing — every link is ICI. The
+    whole-slice reservation assumes HOMOGENEOUS slices (equal device
+    counts per slice, the normal TPU pod shape); pick `k` as a multiple of
+    the slice size to stay on the whole-slice path."""
     if not 0 < k < len(devices):
         raise ValueError(
             f"rollout_devices={k} must leave >=1 of {len(devices)} devices "
@@ -196,4 +204,41 @@ def split_rollout_devices(devices, k: int):
             return (sorted(train, key=lambda d: d.id),
                     sorted(picked, key=lambda d: d.id))
     ordered = sorted(devices, key=lambda d: d.id)
-    return ordered[:-k], ordered[-k:]
+    train, roll = ordered[:-k], ordered[-k:]
+    if all(hasattr(d, "slice_index") for d in devices) \
+            and len({d.slice_index for d in devices}) > 1:
+        # multi-slice pod and the whole-slice reservation failed. Two
+        # distinct fallout modes (single-slice hosts are skipped entirely —
+        # every link there is ICI and there is nothing to warn about):
+        import warnings
+
+        roll_slices = {d.slice_index for d in roll}
+        if len(roll_slices) > 1:
+            # rollout mesh spans slices: its OWN collectives (and they run
+            # every decode step) now cross DCN — the expensive case
+            warnings.warn(
+                f"split_rollout_devices: no suffix of whole slices sums to "
+                f"k={k}; the id-ordered fallback spreads the rollout mesh "
+                f"over slices {sorted(roll_slices)}, so rollout-mesh "
+                "collectives ride DCN every decode step. Pick "
+                "rollout_devices as a multiple of the slice size (the "
+                "whole-slice reservation assumes homogeneous slices).",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        else:
+            # rollout fits inside one slice (its collectives stay on ICI)
+            # but that slice is split with training — the train mesh now
+            # has a partial slice, skewing ITS collective topology
+            warnings.warn(
+                f"split_rollout_devices: no suffix of whole slices sums to "
+                f"k={k}; the id-ordered fallback carves the rollout group "
+                f"out of slice {sorted(roll_slices)}, leaving the TRAIN "
+                "mesh a partial slice (its collectives straddle the cut). "
+                "Rollout-internal collectives stay on ICI. Pick "
+                "rollout_devices as a multiple of the slice size (the "
+                "whole-slice reservation assumes homogeneous slices).",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    return train, roll

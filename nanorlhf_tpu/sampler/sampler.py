@@ -81,9 +81,14 @@ class SamplingParams:
     # prefill — ÷N prefill FLOPs and prompt activation memory, the
     # TPU-static analogue of vLLM's prefix sharing for `n=4` requests
     # (`/root/reference/GRPO/grpo_trainer.py:127`). Token streams are
-    # IDENTICAL to the repeat path (test-pinned): the fanned-out first
-    # logits and caches match the repeated rows' bit for bit, and decode
-    # runs on the same [B*N] shapes either way.
+    # bit-identical to the repeat path on the CPU test mesh (test-pinned:
+    # the fanned-out first logits and caches match the repeated rows', and
+    # decode runs on the same [B*N] shapes either way); on real silicon the
+    # fan-out can change XLA reduction/layout choices enough to flip
+    # near-tie sampling decisions, so streams there are distributionally
+    # equivalent rather than bit-identical (ADVICE r5). Quantify on a given
+    # chip with `tools/ablate_decode.py` (the n4_shared vs n4_repeat
+    # configs measure both the speedup and any stream divergence).
     shared_prompt_prefill: bool = True
 
 

@@ -164,11 +164,43 @@ class CheckpointManager:
 
     def restore(self, step: int, like):
         """Restore the pytree saved at `step`, matching the structure/shardings
-        of `like` (pass {"params": params_template, ...})."""
+        of `like` (pass {"params": params_template, ...}).
+
+        Restored leaves are normalized to match the TEMPLATE's placement —
+        orbax hands back arrays that only LOOK like the template's:
+
+        - a template leaf on a single default device (optax scalar state
+          like Adam's `count`, produced UNCOMMITTED by `jit(optimizer.init)`
+          and therefore auto-replicable by later multi-device jits) comes
+          back from orbax COMMITTED to that device — a donating jitted
+          update then rejects the mixed-device argument list ("Received
+          incompatible devices"). Round-tripping through host restores the
+          uncommitted placement; these leaves are scalars, so the copy is
+          free;
+        - every other leaf is device_put onto the template's sharding (when
+          it differs) and then COPIED into a fresh backend-native buffer:
+          restored arrays are backed by orbax/tensorstore-owned storage,
+          and donating one into the jitted update (which every training
+          step after resume does) segfaults the CPU client — observed as a
+          hard crash one-to-two updates after resume, serial and
+          orchestrated alike."""
         self.wait()
         path = os.path.join(self.output_dir, f"checkpoint-{step}", "tree")
         restored = self._ckptr.restore(path, item=like)
-        return restored
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        def replace(r, l):
+            ls = getattr(l, "sharding", None)
+            if ls is None or not hasattr(r, "sharding"):
+                return r
+            if isinstance(ls, SingleDeviceSharding):
+                return jnp.asarray(np.asarray(r))
+            if r.sharding != ls:
+                r = jax.device_put(r, ls)
+            return jnp.copy(r)  # fresh XLA buffer — safe to donate later
+
+        return jax.tree.map(replace, restored, like)
 
     def truncate_after(self, step: int):
         """Drop checkpoints and metric history newer than `step` — called on

@@ -117,6 +117,41 @@ class RLConfig:
     # params replicated per device — right for models that fit one chip;
     # set tensor/fsdp for bigger policies.
     rollout_mesh: Optional["MeshConfig"] = None
+    # ---- async rollout orchestrator (orchestrator/, docs/ORCHESTRATOR.md).
+    # Generalizes rollout_ahead's one-step prefetch into a producer-thread
+    # pipeline over a version-tagged weight store and a bounded-staleness
+    # sample queue: the rollout mesh runs continuously up to max_staleness
+    # policy versions ahead of training, with backpressure (or drops) at the
+    # bound. Mutually exclusive with rollout_ahead; pairs naturally with
+    # rollout_devices>0 (generation silicon never waits on the train step)
+    # and with sampler_logprob_capture=True, which supplies the behavior
+    # logprobs the truncated-IS off-policy correction needs.
+    rollout_orchestrator: bool = False
+    # max allowed (policy_version - sample_version) at consumption — how many
+    # optimizer updates old a consumed rollout may be. 0 = fully on-policy
+    # (reproduces the synchronous trainer exactly); 1 ≈ rollout_ahead's
+    # pipelining; 2+ deepens the pipeline against jitter.
+    max_staleness: int = 1
+    # what happens to a QUEUED sample that goes over-stale anyway — possible
+    # only under an abnormal publish-without-consume cadence (external
+    # weight syncs; the producer gate itself is identical in both modes and
+    # never admits a sample that could exceed the bound under the normal
+    # one-publish-per-consume cadence): "wait" still delivers it (the
+    # truncated-IS correction absorbs the extra staleness); "drop" discards
+    # it and takes the next fresh sample (orchestrator/dropped_total counts
+    # the discards).
+    staleness_policy: str = "wait"
+    # off-policy correction for stale samples: "truncated_is" re-weights
+    # each loss term by min(π_old/μ, offpolicy_is_truncation) using the
+    # sampler-captured behavior logprobs μ (algos/losses.truncated_is_weights)
+    # — active only when the orchestrator runs at max_staleness > 0 WITH
+    # sampler_logprob_capture (otherwise μ is unknown and the PPO ratio clip
+    # alone absorbs the drift, as under rollout_ahead). "none" disables.
+    offpolicy_correction: str = "truncated_is"  # truncated_is | none
+    # ρ̄, the IS weight truncation (IMPALA/V-trace c̄): bounds the correction's
+    # variance at a small bias toward under-weighting fresh-policy-favored
+    # tokens.
+    offpolicy_is_truncation: float = 2.0
 
     # ---- optimization ----
     learning_rate: float = 6e-6

@@ -15,6 +15,17 @@ import os
 import time
 
 
+def staleness_histogram_metrics(counts: dict, prefix: str = "orchestrator") -> dict:
+    """Flatten the orchestrator's sample-staleness histogram into scalar
+    metric keys (`orchestrator/staleness_hist_K` = cumulative count of
+    samples consumed at staleness K) — JSONL/TB sinks take scalars only,
+    and cumulative counts diff cleanly into per-window rates downstream."""
+    return {
+        f"{prefix}/staleness_hist_{int(k)}": float(v)
+        for k, v in sorted(counts.items(), key=lambda kv: int(kv[0]))
+    }
+
+
 class MetricsLogger:
     """Sinks: "jsonl" (default), "tensorboard" (jsonl + TB event files via
     torch's SummaryWriter — the reference's value-init reports to tensorboard,

@@ -253,7 +253,9 @@ class SparseGRPOTrainer(RLTrainer):
             return self._apply_grads_cached
         optimizer = self.optimizer
 
-        @partial(jax.jit, donate_argnums=(0, 1))
+        from nanorlhf_tpu.trainer.trainer import donate_argnums_on_accel
+
+        @partial(jax.jit, donate_argnums=donate_argnums_on_accel(0, 1))
         def apply_grads(trainable, opt_state, grads):
             updates, opt_state = optimizer.update(grads, opt_state, trainable)
             return optax.apply_updates(trainable, updates), opt_state
@@ -283,6 +285,14 @@ class SparseGRPOTrainer(RLTrainer):
 
     def train(self, num_updates: Optional[int] = None):
         cfg, tok = self.cfg, self.tokenizer
+        if cfg.rollout_orchestrator:
+            raise ValueError(
+                "rollout_orchestrator is not supported by SparseGRPOTrainer "
+                "yet: the sparse all-zero-advantage skip consumes a rollout "
+                "WITHOUT publishing a policy version, which would wedge the "
+                "bounded-staleness gate (orchestrator/sample_queue.py). Use "
+                "rollout_ahead for overlap on the sparse path."
+            )
         pad_id, eos_id = tok.pad_token_id, tok.eos_token_id
         n = cfg.sample_n
         sp_on = self._sp_on()
@@ -326,7 +336,7 @@ class SparseGRPOTrainer(RLTrainer):
             )
             return {"queries": queries, "gen_out": gen_out}
 
-        stream = RolloutStream(self, rollout_body)
+        stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
         for update in range(1, n_updates + 1):
             t_start = time.time()
             self.state["episode"] += cfg.batch_size

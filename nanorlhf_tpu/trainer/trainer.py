@@ -69,7 +69,8 @@ from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
 from nanorlhf_tpu.sampler import SamplingParams, generate
 from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
 from nanorlhf_tpu.trainer.config import AlgoName, RLConfig
-from nanorlhf_tpu.trainer.metrics import MetricsLogger
+from nanorlhf_tpu.trainer.metrics import (MetricsLogger,
+                                          staleness_histogram_metrics)
 
 # Rollout-phase forward chunking. Two independent memory models bound the
 # chunk: (1) the reference's empirical activation budget `22*2316` tokens
@@ -84,6 +85,21 @@ _LOGITS_BYTES_BUDGET = 2 * 1024**3
 def forward_token_budget(vocab_size: int, bytes_per_elem: int = 2) -> int:
     vocab_cap = max(1024, _LOGITS_BYTES_BUDGET // (vocab_size * bytes_per_elem))
     return min(ACTIVATION_TOKEN_BUDGET, vocab_cap)
+
+
+def donate_argnums_on_accel(*nums: int) -> tuple:
+    """Buffer donation argnums, gated off on the CPU backend.
+
+    On accelerators donation lets XLA reuse the params/opt-state HBM across
+    the update — essential at scale. On the CPU backend it buys nothing
+    (host RAM, test-sized models) and is LETHAL in combination with the
+    persistent compilation cache on current jaxlib: an executable
+    deserialized from the cache with donated buffers segfaults/aborts the
+    process a few optimizer steps in (deterministically reproduced via
+    repeated train/resume cycles — fresh or warm cache alike; with donation
+    off, the same sequence passes). Launchers enable the cache for every
+    backend, so this protects CPU demo runs as well as the test suite."""
+    return nums if jax.default_backend() != "cpu" else ()
 
 
 def pad_chunk(rows: np.ndarray, chunk: int) -> np.ndarray:
@@ -117,19 +133,35 @@ class RolloutStream:
     evolving trainer key: rollout_ahead dispatches rollout k+1 before update
     k's host-side draws, and a shared stream would reorder splits between
     modes (and break bit-exact resume).
+
+    `meter` (an orchestrator.OverlapMeter) records every dispatch's true
+    [dispatch, device-ready] window via a waiter thread, so serial /
+    rollout_ahead runs report the same rollout/train overlap-fraction
+    metric the RolloutOrchestrator does (docs/ORCHESTRATOR.md).
     """
 
-    def __init__(self, trainer, body: Callable):
+    def __init__(self, trainer, body: Callable, meter=None):
         self._t = trainer
         self._body = body
         self._idx = trainer.state["rollouts"]
         self._pending = None
+        if meter is None:
+            from nanorlhf_tpu.orchestrator import OverlapMeter
+
+            meter = OverlapMeter()
+        self.meter = meter
 
     def dispatch(self) -> dict:
+        from nanorlhf_tpu.orchestrator import note_ready_async
+
         t = self._t
         queries = np.asarray(next(t._iter))
         key = jax.random.fold_in(t._rollout_base, self._idx)
+        t0 = time.time()
         ro = self._body(queries, key)
+        # hand the watcher a FROZEN view of the async outputs — blocking on
+        # `ro` itself would race the "_index" insertion below
+        note_ready_async(self.meter, (ro["gen_out"], ro.get("greedy")), t0)
         ro["_index"] = self._idx
         self._idx += 1
         return ro
@@ -226,6 +258,42 @@ class RLTrainer:
         config.finalize_world(
             self.mesh.shape.get("data", 1) * self.mesh.shape.get("fsdp", 1)
         )
+
+        # ---- async rollout orchestrator (orchestrator/) ------------------
+        if config.rollout_orchestrator:
+            if config.rollout_ahead:
+                raise ValueError(
+                    "rollout_orchestrator generalizes rollout_ahead — enable "
+                    "one, not both"
+                )
+            if config.max_staleness < 0:
+                raise ValueError(f"max_staleness={config.max_staleness}")
+            if config.staleness_policy not in ("wait", "drop"):
+                raise ValueError(
+                    f"staleness_policy={config.staleness_policy!r}: wait|drop"
+                )
+        if config.offpolicy_correction not in ("truncated_is", "none"):
+            raise ValueError(
+                f"offpolicy_correction={config.offpolicy_correction!r}"
+            )
+        # truncated-IS correction needs the behavior policy's logprobs —
+        # only the sampler capture provides them; without capture the PPO
+        # ratio clip alone absorbs the staleness drift (rollout_ahead's
+        # documented behavior)
+        self._use_is = (
+            config.rollout_orchestrator
+            and config.max_staleness > 0
+            and config.sampler_logprob_capture
+            and config.offpolicy_correction == "truncated_is"
+        )
+        self._orchestrator = None
+        self._orch_restore_state = None  # journal from a resumed checkpoint
+        from nanorlhf_tpu.orchestrator import OverlapMeter
+
+        # ONE meter for the whole trainer lifetime (stream objects are
+        # recreated per train() call): the rollout/train overlap fraction
+        # accumulates across calls — how bench invokes training
+        self._rollout_meter = OverlapMeter()
 
         self.key = rng_key if rng_key is not None else jax.random.PRNGKey(config.seed)
         # generation PRNG is a dedicated STATELESS stream keyed by rollout
@@ -366,27 +434,32 @@ class RLTrainer:
     # rollout weight quantization
     # ------------------------------------------------------------------ #
 
-    def _refresh_quant_layers(self):
+    def _refresh_quant_layers(self, src: Optional[dict] = None):
         from nanorlhf_tpu.core.quant import quantize_layers
 
-        q = quantize_layers(self.params["layers"])
+        src = self.params if src is None else src
+        q = quantize_layers(src["layers"])
         self._quant_layers = shard_params({"layers": q}, self.mesh)["layers"]
 
-    def _rollout_params(self):
+    def _rollout_params(self, tree: Optional[dict] = None):
         """The param tree generation samples from: exact everywhere, except
         int8 base projections when rollout_quant is on (LoRA/embed/norm are
         always the live exact arrays — see core/quant.py). With a dedicated
         rollout mesh, the view is re-sharded onto it here — the once-per-
         dispatch param sync (an async device_put tree; the only transfer
-        that crosses the train/rollout device groups)."""
+        that crosses the train/rollout device groups). `tree` overrides the
+        live self.params source — the orchestrator's producer thread passes
+        a PUBLISHED snapshot so generation never races the jitted update's
+        buffer donation."""
+        src = self.params if tree is None else tree
         if self._quant_layers is None:
-            tree = self.params
+            tree = src
         else:
             if not self.cfg.use_lora:  # full FT: base changed since last update
-                self._refresh_quant_layers()
+                self._refresh_quant_layers(src)
             from nanorlhf_tpu.core.quant import rollout_view
 
-            tree = rollout_view(self.params, self._quant_layers)
+            tree = rollout_view(src, self._quant_layers)
         if self.rollout_mesh is not None:
             if self.cfg.use_lora:
                 # LoRA freezes the base: re-shard it onto the rollout mesh
@@ -403,6 +476,56 @@ class RLTrainer:
             else:
                 tree = shard_params(tree, self.rollout_mesh)
         return tree
+
+    # ------------------------------------------------------------------ #
+    # async rollout orchestrator (orchestrator/, docs/ORCHESTRATOR.md)
+    # ------------------------------------------------------------------ #
+
+    def _policy_snapshot(self) -> dict:
+        """An immutable view of the current policy for the weight store:
+        the TRAINABLE leaves are copied (the jitted update donates exactly
+        those buffers — a producer-thread generation reading them live
+        would race the donation), frozen leaves alias the live arrays
+        (never donated, never mutated). Under LoRA the copy is MBs of
+        adapters; full fine-tuning pays a full-tree copy per publish."""
+        mask = trainable_mask(self.params, self.lora_cfg)
+        return jax.tree.map(
+            lambda p, m: jnp.copy(p) if m else p, self.params, mask
+        )
+
+    def _ensure_orchestrator(self, body: Callable):
+        """Create (once) the producer-thread pipeline. The orchestrator
+        outlives train() calls — the pipeline stays warm across repeated
+        train(num_updates=1) invocations (how bench measures) — and is torn
+        down by close() or resume_from_checkpoint()."""
+        if self._orchestrator is None:
+            from nanorlhf_tpu.orchestrator import RolloutOrchestrator
+
+            def dispatch(index: int, tree: dict) -> dict:
+                # the producer is the SOLE consumer of the data iterator,
+                # and keys come from the stateless index-keyed stream — the
+                # same (data, PRNG) cursors the synchronous trainer uses,
+                # so checkpoint/resume fast-forwards reproduce the streams
+                queries = np.asarray(next(self._iter))
+                key = jax.random.fold_in(self._rollout_base, index)
+                return body(queries, key, tree)
+
+            self._orchestrator = RolloutOrchestrator(
+                dispatch_fn=dispatch,
+                initial_params=self._policy_snapshot(),
+                start_index=self.state["rollouts"],
+                max_staleness=self.cfg.max_staleness,
+                policy=self.cfg.staleness_policy,
+                meter=self._rollout_meter,
+                restore=self._orch_restore_state,
+            )
+            self._orch_restore_state = None
+        return self._orchestrator
+
+    def rollout_overlap_frac(self) -> float:
+        """Cumulative rollout/train overlap fraction (orchestrator metric;
+        also measured for serial / rollout_ahead runs) — bench reads this."""
+        return self._rollout_meter.overlap_fraction()
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -496,6 +619,11 @@ class RLTrainer:
         pad_id = self.tokenizer.pad_token_id
         optimizer = self.optimizer
         grad_accum = cfg.gradient_accumulation_steps
+        # truncated-IS off-policy correction (orchestrator staleness > 0 with
+        # captured behavior logprobs): static for the whole run, so the
+        # minibatch dict's key set — and the jitted update — never changes
+        use_is = self._use_is
+        is_truncation = cfg.offpolicy_is_truncation
 
         combine = self._combine
         sp_on = self._sp_on()
@@ -540,23 +668,31 @@ class RLTrainer:
                 mb["padding_mask"], INVALID_LOGPROB, new_logprobs
             )
             mask = ~mb["padding_mask"]
+            # behavior (stale sampling policy) logprobs for truncated IS —
+            # None keeps every loss in its exact synchronous form
+            behavior = mb["behavior_logprobs"] if use_is else None
 
             if algo == AlgoName.GRPO:
                 loss, aux = grpo_loss(
                     new_logprobs, mb["logprobs"], mb["ref_logprobs"],
                     mb["advantages"], mask, cfg.cliprange, cfg.kl_coef,
+                    behavior_logprobs=behavior, is_truncation=is_truncation,
                 )
             elif algo == AlgoName.RLOO:
                 loss, aux = ppo_clip_loss_sequence(
                     new_logprobs, mb["logprobs"], mb["advantages_seq"], mask,
                     cfg.cliprange,
+                    behavior_logprobs=behavior, is_truncation=is_truncation,
                 )
             elif algo == AlgoName.RAFT:
+                # RAFT's SFT objective has no ratio to correct — best-of-K
+                # selection is off-policy by construction
                 loss, aux = sft_loss(new_logprobs, mask)
             elif algo == AlgoName.PPO:
                 pg_loss, aux = ppo_clip_loss_token(
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
+                    behavior_logprobs=behavior, is_truncation=is_truncation,
                 )
                 if sp_on:
                     from nanorlhf_tpu.parallel.sp import sp_score_values
@@ -586,6 +722,7 @@ class RLTrainer:
                 loss, aux = ppo_clip_loss_token(
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
+                    behavior_logprobs=behavior, is_truncation=is_truncation,
                 )
             aux["entropy"] = entropy
             return loss, aux
@@ -654,9 +791,10 @@ class RLTrainer:
 
         from functools import partial
 
-        return partial(jax.jit, static_argnums=(4,), donate_argnums=(0, 2))(
-            update_minibatch
-        )
+        return partial(
+            jax.jit, static_argnums=(4,),
+            donate_argnums=donate_argnums_on_accel(0, 2),
+        )(update_minibatch)
 
     # ------------------------------------------------------------------ #
     # sequence parallelism (mesh sp > 1): the logprob/score pass and the
@@ -813,6 +951,12 @@ class RLTrainer:
 
         n = cfg.sample_n if self.algo in (AlgoName.GRPO, AlgoName.RLOO, AlgoName.RAFT) else 1
         capture = cfg.sampler_logprob_capture
+        # with truncated-IS correction the captured logprobs are the STALE
+        # behavior policy's — they feed the IS weights, not the "old"
+        # logprobs the clip ratio needs, so the policy scoring pass must
+        # still run (score_capture=False) to measure π_old on the current
+        # params
+        score_capture = capture and not self._use_is
         sampling = SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, n=n,
             max_tokens=cfg.response_length, capture_logprobs=capture,
@@ -832,8 +976,10 @@ class RLTrainer:
         ctx_menu = shape_menu(self.dataset.input_ids.shape[1], min_value=16) \
             if hasattr(self.dataset, "input_ids") else None
 
-        def rollout_body(queries, gen_key):
-            """DISPATCH one rollout (async — nothing blocks until fetched)."""
+        def rollout_body(queries, gen_key, gen_tree=None):
+            """DISPATCH one rollout (async — nothing blocks until fetched).
+            `gen_tree` (orchestrated mode) is a published weight-store
+            snapshot; None samples from the live params."""
             if ctx_menu is not None:
                 # r1's de-padding applied to every algorithm: batches of short
                 # prompts roll out / score at a menu-rounded context (warm jit
@@ -846,7 +992,7 @@ class RLTrainer:
             )
             queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
-            gen_params = self._rollout_params()
+            gen_params = self._rollout_params(gen_tree)
             gen_out = generate(
                 gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
@@ -863,14 +1009,29 @@ class RLTrainer:
                 )
             return {"queries": queries, "gen_out": gen_out, "greedy": greedy}
 
-        stream = RolloutStream(self, rollout_body)
+        use_orch = cfg.rollout_orchestrator
+        if use_orch:
+            orch = self._ensure_orchestrator(rollout_body)
+            stream, meter = None, orch.meter
+        else:
+            orch = None
+            stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
+            meter = stream.meter
+        sample_staleness, queue_depth = 0, 0
         for update in range(1, n_updates + 1):
             t_start = time.time()
             self.state["episode"] += cfg.batch_size
 
             # ---- ROLLOUT -------------------------------------------------
             with self.timer.phase("rollout"):
-                ro = stream.fetch_or_dispatch()
+                if use_orch:
+                    sample = orch.get()
+                    ro = sample.payload
+                    self.state["rollouts"] = sample.index + 1
+                    sample_staleness = orch.version - sample.version
+                    queue_depth = orch.queue.depth()
+                else:
+                    ro = stream.fetch_or_dispatch()
                 if capture:
                     responses, captured_lp = ro["gen_out"]
                     captured_lp = np.asarray(captured_lp)
@@ -880,9 +1041,10 @@ class RLTrainer:
                 greedy_responses = ro["greedy"]
                 if greedy_responses is not None:
                     greedy_responses.block_until_ready()
+            t_busy0 = time.time()  # overlap meter: consumer busy from here
             queries = ro["queries"]
             batch_size, context_length = queries.shape
-            if cfg.rollout_ahead and update < n_updates:
+            if not use_orch and cfg.rollout_ahead and update < n_updates:
                 # dispatch rollout k+1 NOW (from the pre-update-k params, one
                 # update stale): the device generates while the host below
                 # decodes/grades update k's batch
@@ -950,9 +1112,9 @@ class RLTrainer:
             chunk = max(1, min(total, chunk))
             logprobs_l, ref_logprobs_l = [], []
             ref_free = self._ref_free
-            one_fn = self._single_scorer_for(capture)
+            one_fn = self._single_scorer_for(score_capture)
             with self.timer.phase("logprob"):
-                if ref_free and capture:
+                if ref_free and score_capture:
                     # zero scoring forwards: policy logprobs came from the
                     # sampler, and there is no reference model (kl_coef 0 —
                     # the reference's r1 path, `grpo_r1.py:138`)
@@ -965,7 +1127,7 @@ class RLTrainer:
                             # policy-only forward (adapters applied)
                             lp = one_fn(self.params, rows_c, context_length)
                             logprobs_l.append(np.asarray(lp)[:n_real])
-                        elif capture:
+                        elif score_capture:
                             # policy logprobs came from the sampler; only the
                             # ref pass runs — half the scoring forwards
                             rlp = one_fn(self.ref_params, rows_c, context_length)
@@ -978,7 +1140,7 @@ class RLTrainer:
                             logprobs_l.append(np.asarray(lp)[:n_real])
                             ref_logprobs_l.append(np.asarray(rlp)[:n_real])
             logprobs = (
-                captured_lp if capture else np.concatenate(logprobs_l)
+                captured_lp if score_capture else np.concatenate(logprobs_l)
             ).astype(np.float32)
             # ref == policy-old in ref-free mode: every KL term and metric
             # reads exactly 0, matching "no reference model"
@@ -999,6 +1161,13 @@ class RLTrainer:
             padding_mask_p1 = np.asarray(padding_mask_p1)
             logprobs = np.where(padding_mask, INVALID_LOGPROB, logprobs)
             ref_logprobs = np.where(padding_mask, INVALID_LOGPROB, ref_logprobs)
+            behavior_lp = None
+            if self._use_is:
+                # the STALE sampling policy's logprobs, masked exactly like
+                # `logprobs` so the IS weight is 1 at padded positions
+                behavior_lp = np.where(
+                    padding_mask, INVALID_LOGPROB, captured_lp
+                ).astype(np.float32)
 
             contain_eos = (np.asarray(postprocessed) == eos_id).any(axis=1)
             scores_sel = grpo_adv if self.algo == AlgoName.GRPO else scores
@@ -1010,6 +1179,7 @@ class RLTrainer:
             batch, keep_inds, reward_info = self._assemble_batch(
                 scores_sel, logprobs, ref_logprobs, padding_mask, padding_mask_p1,
                 seq_lengths, qr, responses_np, context_length, batch_size, n,
+                behavior_lp=behavior_lp,
             )
             if keep_inds is not None:
                 # RLOO/RAFT selected 1-of-N *after* the logprob pass; realign
@@ -1056,6 +1226,11 @@ class RLTrainer:
                 self.params = train_tree["policy"]
                 self.value_params = train_tree.get("value")
                 all_stats = jax.device_get(all_stats)
+            if use_orch:
+                # one version per optimizer update: snapshot the trainable
+                # leaves (donation hazard) and open the producer's gate
+                with self.timer.phase("publish"):
+                    orch.publish(self._policy_snapshot())
 
             # ---- METRICS (names + semantics per docs/METRICS.md) -----------
             sec_per_episode = (time.time() - t_start) / cfg.batch_size
@@ -1117,12 +1292,33 @@ class RLTrainer:
             if "vf_loss" in agg:
                 metrics["loss/value_avg_new"] = agg["vf_loss"]
                 metrics["val/clipfrac_avg_new"] = agg.get("vf_clipfrac", 0.0)
-            if capture:
+            if score_capture:
                 # with exact scoring the epoch-1 ratio is identically 1; any
                 # deviation here is decode-vs-scoring numerics — the guard
                 # for the captured-logprob shortcut
                 metrics["sampler_capture/ratio_drift_new"] = abs(
                     agg.get("ratio_mean", 1.0) - 1.0
+                )
+            # rollout/train overlap fraction: measured for EVERY mode
+            # (serial ≈ 0, rollout_ahead partial, orchestrator highest) —
+            # the bench payload's pipelining signal
+            metrics["time/rollout_overlap_frac"] = meter.overlap_fraction()
+            if use_orch:
+                ostats = orch.stats()
+                metrics.update({
+                    "orchestrator/queue_depth": float(queue_depth),
+                    "orchestrator/staleness": float(sample_staleness),
+                    "orchestrator/dropped_total": float(ostats["dropped"]),
+                })
+                metrics.update(staleness_histogram_metrics(
+                    ostats["staleness_counts"]
+                ))
+            if self._use_is:
+                metrics["offpolicy/is_weight_mean_new"] = agg.get(
+                    "is_weight_mean", 1.0
+                )
+                metrics["offpolicy/is_trunc_frac_new"] = agg.get(
+                    "is_trunc_frac", 0.0
                 )
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
@@ -1135,17 +1331,28 @@ class RLTrainer:
 
             # ---- CHECKPOINT ------------------------------------------------
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
+                extra_state = {"episode": self.state["episode"],
+                               "opt_steps": self.state["opt_steps"],
+                               "rollouts": self.state["rollouts"]}
+                if use_orch:
+                    # journal the queue: pending (dispatched, unconsumed)
+                    # indices + cumulative drop/staleness counters. Resume
+                    # re-draws the pending samples from the consumed-rollout
+                    # cursor — the index-keyed PRNG and deterministic loader
+                    # reproduce their token streams (docs/ORCHESTRATOR.md)
+                    extra_state["orchestrator"] = orch.journal()
                 self.ckpt.save(
                     self.state["global_step"], self.params,
                     opt_state=self.opt_state if cfg.save_optimizer_state else None,
                     rng_key=self.key,
                     metric_old=metrics[cfg.metric_for_best_model]
                     if cfg.metric_for_best_model in metrics else None,
-                    extra_state={"episode": self.state["episode"],
-                                 "opt_steps": self.state["opt_steps"],
-                                 "rollouts": self.state["rollouts"]},
+                    extra_state=extra_state,
                     value_params=self.value_params if cfg.save_value_model else None,
                 )
+            # overlap meter: consumer busy window = everything since the
+            # sample was fetched (reward, scoring, update, logging, save)
+            meter.note_busy(t_busy0, time.time())
 
         # train() returning implies every checkpoint is DURABLE: flush the
         # in-flight async save (saves mid-run overlap training; only this
@@ -1189,6 +1396,13 @@ class RLTrainer:
         step = step if step is not None else latest
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.cfg.output_dir}")
+        if self._orchestrator is not None:
+            # queued samples were generated from pre-restore params (and the
+            # producer's data cursor ran ahead of the consumed counter) —
+            # tear the pipeline down; train() rebuilds it from the restored
+            # cursor and the journaled counters
+            self._orchestrator.close()
+            self._orchestrator = None
         restored = self.ckpt.restore(step, self._restore_template())
         if latest is not None and step < latest:
             # resuming an earlier step abandons the newer trajectory
@@ -1222,6 +1436,10 @@ class RLTrainer:
         # params, so the first post-resume rollout is one update fresher
         # than the uninterrupted run's would have been.
         self.state["rollouts"] = tstate.get("rollouts", tstate["step"])
+        # orchestrator journal: seeds the rebuilt queue's cumulative
+        # drop/staleness counters so the metric series stays continuous
+        # (pending samples are re-drawn from the rollouts cursor)
+        self._orch_restore_state = tstate.get("orchestrator")
         self._iter = self.dataset.loader(self.cfg.batch_size, self.cfg.seed) \
             if hasattr(self.dataset, "loader") else iter(self.dataset)
         for _ in range(self.state["rollouts"]):
@@ -1242,6 +1460,9 @@ class RLTrainer:
         )
 
     def close(self):
+        if self._orchestrator is not None:
+            self._orchestrator.close()  # stop + join the producer thread
+            self._orchestrator = None
         self.ckpt.close()  # flush any in-flight async checkpoint write
         self.logger.close()
 
@@ -1251,7 +1472,7 @@ class RLTrainer:
 
     def _assemble_batch(self, scores, logprobs, ref_logprobs, padding_mask,
                         padding_mask_p1, seq_lengths, qr, responses,
-                        context_length, batch_size, n):
+                        context_length, batch_size, n, behavior_lp=None):
         cfg = self.cfg
         T = responses.shape[1]
         kl = logprobs - ref_logprobs
@@ -1262,6 +1483,10 @@ class RLTrainer:
             "padding_mask": padding_mask,
             "padding_mask_p1": padding_mask_p1,
         }
+        if behavior_lp is not None:
+            # rides through every per-algo selection below (RLOO/RAFT map
+            # over batch.items()) and into the jitted update's minibatches
+            batch["behavior_logprobs"] = behavior_lp
 
         if self.algo == AlgoName.GRPO:
             # sparse terminal advantage, reversed cumsum γ=1, KL stays in-loss

@@ -177,14 +177,29 @@ def heal_and_claim(path: str) -> str:
     return sentinel
 
 
+# set by the first successful enable_compilation_cache(): later calls are
+# true no-ops returning this dir (ADVICE r5 — conftest, launchers, bench and
+# tools all call enable; repeat claims would stack one atexit/SIGTERM
+# handler per call and re-run the crash-heal scan under our own live claim)
+_enabled_dir: str | None = None
+
+
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Point jax's persistent compilation cache at a fingerprinted dir,
     with crash-heal + pid-sentinel claim (see `heal_and_claim`).
 
-    Idempotent; safe to call before or after backend init (the config only
-    has to be set before the first compile). Returns the dir, or None when
-    disabled (`NANORLHF_CACHE_DIR=0`) or unsupported by this jax.
+    Idempotent AND once-only per process: the first successful call claims
+    the dir and registers the single sentinel-cleanup handler; every later
+    call returns the already-enabled dir without touching disk or handlers
+    (even if a different `cache_dir` is passed — re-pointing a live jax
+    cache mid-process is not supported). Safe to call before or after
+    backend init (the config only has to be set before the first compile).
+    Returns the dir, or None when disabled (`NANORLHF_CACHE_DIR=0`) or
+    unsupported by this jax.
     """
+    global _enabled_dir
+    if _enabled_dir is not None:
+        return _enabled_dir
     import jax
 
     path = cache_dir or default_cache_dir()
@@ -198,4 +213,5 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         return None  # older jax / read-only fs — run uncached
+    _enabled_dir = path
     return path

@@ -1,0 +1,34 @@
+"""shard_map version compatibility — ONE import site for the API drift
+between jax 0.4.x and current jax:
+
+- location: `jax.shard_map` (new top-level export) vs
+  `jax.experimental.shard_map.shard_map` (0.4.x);
+- replication-check kwarg: `check_vma` (new name) vs `check_rep` (0.4.x).
+
+Lives in utils (imports nothing from core/parallel) so both layers can use
+it without cycles.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # builtins without signatures
+    _PARAMS = None
+
+
+def shard_map(f=None, /, **kwargs):
+    """`jax.shard_map` with `check_vma=` translated to `check_rep=` when the
+    installed jax predates the rename. Call with the mapped function
+    positionally and everything else by keyword (how this repo calls it)."""
+    if _PARAMS is not None and "check_vma" in kwargs \
+            and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs) if f is not None else _shard_map(**kwargs)

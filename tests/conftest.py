@@ -30,6 +30,10 @@ jax.config.update("jax_enable_x64", False)
 # bench, and tools, which write the same dir: every writer claims a
 # sentinel, or it would be invisible to the healer (its crashes never
 # heal) and unprotected from it (a heal could rmtree under it).
+# NOTE: cache-deserialized CPU executables with DONATED buffers abort the
+# process on this jaxlib — which is why the trainer gates buffer donation
+# off on the CPU backend (trainer.donate_argnums_on_accel); without that
+# gate this cache would have to stay off for the whole suite.
 from nanorlhf_tpu.utils.compile_cache import (  # noqa: E402
     enable_compilation_cache,
     sentinel_path,

@@ -1,0 +1,54 @@
+"""enable_compilation_cache is once-only per process (ADVICE r5): the first
+successful call latches the dir; every later call — launchers, bench
+helpers, tools importing the module — must be a true no-op that neither
+re-claims the dir (stacking atexit/SIGTERM handlers, re-running the
+crash-heal scan under our own live claim) nor re-points a live jax cache.
+
+Internals are monkeypatched so the test never enables a REAL cache in this
+pytest process — conftest deliberately runs the suite uncached (deserialized
+XLA:CPU executables abort under the donating update on this jaxlib).
+"""
+
+import jax
+import pytest
+
+import nanorlhf_tpu.utils.compile_cache as cc
+
+
+def test_enable_latches_then_noops(monkeypatch, tmp_path):
+    claims = []
+    monkeypatch.setattr(cc, "_enabled_dir", None)
+    monkeypatch.setattr(cc, "heal_and_claim", lambda p: claims.append(p))
+    monkeypatch.setattr(jax.config, "update", lambda *a, **k: None)
+
+    d = str(tmp_path / "cache")
+    assert cc.enable_compilation_cache(d) == d
+    assert claims == [d]
+
+    def boom(path):
+        raise AssertionError("repeat call must not re-claim the cache dir")
+
+    monkeypatch.setattr(cc, "heal_and_claim", boom)
+    # repeat call: same dir back, no claim, no handler registration
+    assert cc.enable_compilation_cache() == d
+    # even an explicit different dir is ignored once enabled (re-pointing a
+    # live jax cache mid-process is unsupported)
+    assert cc.enable_compilation_cache(str(tmp_path / "other")) == d
+
+
+def test_disabled_env_does_not_latch(monkeypatch):
+    monkeypatch.setattr(cc, "_enabled_dir", None)
+    monkeypatch.setenv("NANORLHF_CACHE_DIR", "0")
+    assert cc.enable_compilation_cache() is None
+    assert cc._enabled_dir is None  # a later call may still enable
+
+
+def test_failure_does_not_latch(monkeypatch, tmp_path):
+    monkeypatch.setattr(cc, "_enabled_dir", None)
+
+    def fail(path):
+        raise OSError("read-only fs")
+
+    monkeypatch.setattr(cc, "heal_and_claim", fail)
+    assert cc.enable_compilation_cache(str(tmp_path / "c")) is None
+    assert cc._enabled_dir is None

@@ -53,10 +53,38 @@ def test_split_prefers_whole_slice():
 
 
 def test_split_no_whole_slice_falls_back():
-    # k=2 can't be a whole 4-device slice → id-ordered tail
+    # k=2 can't be a whole 4-device slice → id-ordered tail, warned: the
+    # rollout group fits in slice 1 (ICI-internal) but leaves the TRAIN
+    # mesh a partial slice (ADVICE r5)
     devs = [FakeDev(i, slice_index=i // 4) for i in range(8)]
-    train, roll = split_rollout_devices(devs, 2)
+    with pytest.warns(RuntimeWarning, match="partial slice"):
+        train, roll = split_rollout_devices(devs, 2)
     assert [d.id for d in roll] == [6, 7]
+
+
+def test_split_fallback_warns_when_rollout_spans_slices():
+    # k=6 over two 4-device slices: tail takes all of slice 1 plus half of
+    # slice 0 — rollout-internal collectives would cross DCN
+    devs = [FakeDev(i, slice_index=i // 4) for i in range(8)]
+    with pytest.warns(RuntimeWarning, match="DCN every decode step"):
+        _, roll = split_rollout_devices(devs, 6)
+    assert len({d.slice_index for d in roll}) == 2
+
+
+def test_split_no_warning_without_slice_index(recwarn):
+    # CPU test meshes (no slice_index): the tail fallback is the normal
+    # path and must stay silent
+    devs = [FakeDev(i) for i in range(8)]
+    split_rollout_devices(devs, 2)
+    assert not [w for w in recwarn if issubclass(w.category, RuntimeWarning)]
+
+
+def test_split_no_warning_on_single_slice(recwarn):
+    # a single-slice host (e.g. v4-8): every link is ICI — the fallback is
+    # the only possible path and must not cry DCN
+    devs = [FakeDev(i, slice_index=0) for i in range(8)]
+    split_rollout_devices(devs, 2)
+    assert not [w for w in recwarn if issubclass(w.category, RuntimeWarning)]
 
 
 def test_split_bounds():
