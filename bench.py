@@ -29,6 +29,9 @@ BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
 BENCH_ORCH (0 | 1: async rollout orchestrator, docs/ORCHESTRATOR.md),
 BENCH_STALENESS (2: orchestrator max_staleness),
 BENCH_KV_QUANT (0 | 1: int8 KV cache),
+BENCH_SENTINEL (1: also measure the training sentinel disabled and report
+detail.sentinel.sentinel_overhead_frac — the resilience guard's cost on
+the step wall, docs/RESILIENCE.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -479,7 +482,7 @@ def run_bench(jax, init_error):
                                   max_prompt_len=64)
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
-                orchestrator=False, staleness=2):
+                orchestrator=False, staleness=2, sentinel=True):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -510,6 +513,7 @@ def run_bench(jax, init_error):
             rollout_ahead=ahead and not orchestrator,
             rollout_orchestrator=orchestrator,
             max_staleness=staleness,
+            sentinel=sentinel,
             kv_cache_quant=kv_quant,
             gradient_checkpointing=True,
             mesh=MeshConfig(n_dev, 1, 1),
@@ -632,6 +636,37 @@ def run_bench(jax, init_error):
                     f"{type(e).__name__}: {e}"[:300]
                 )
 
+    # sentinel-overhead point (docs/RESILIENCE.md acceptance: the guard
+    # costs <2% of the step wall): re-measure the chosen config with the
+    # training sentinel disabled and report the relative delta. The
+    # sentinel-off run reuses the chosen config's compiled executables
+    # EXCEPT the update fn (whose grad-norm stat is emitted regardless of
+    # the flag, so even that recompile is shape-identical) — cheap relative
+    # to a full lever sweep, still gated on remaining budget.
+    sentinel_detail = None
+    if (os.environ.get("BENCH_SENTINEL", "1") == "1"
+            and budget - (time.time() - _T0) > 0.9 * t_baseline):
+        try:
+            guard_off = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"],
+                capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
+                sentinel=False,
+            )
+            off_sec = guard_off["sec_per_update_steady"]
+            sentinel_detail = {
+                "on_sec_per_update": chosen["sec_per_update_steady"],
+                "off_sec_per_update": off_sec,
+                "sentinel_overhead_frac": round(
+                    (chosen["sec_per_update_steady"] - off_sec)
+                    / max(off_sec, 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            sentinel_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # secondary short-response point (the r1/r2 rounds' resp-256 shape) so
     # the payload carries BOTH operating points — the resp-1500 headline
     # stays baseline-comparable and the short point tracks decode-lever
@@ -735,6 +770,8 @@ def run_bench(jax, init_error):
     }
     if sweep_detail is not None:
         detail["sweep"] = sweep_detail
+    if sentinel_detail is not None:
+        detail["sentinel"] = sentinel_detail
     if short_detail is not None:
         detail["short_response"] = short_detail
     if init_error is not None:

@@ -129,7 +129,17 @@ def run(cfg: RLConfig, value_params_fn=None, post_build=None):
     )
     if post_build is not None:
         post_build(trainer, dataset, reward_func)
+    from nanorlhf_tpu.resilience import Preempted
+
     try:
         return trainer.train()
+    except Preempted as e:
+        # SIGTERM during training: the loop already flushed the in-flight
+        # async save and committed an emergency checkpoint — exit cleanly
+        # (resume_from_checkpoint picks the run back up) instead of dumping
+        # a stack trace into the preemption logs
+        print(f"[preemption] {e} — exiting cleanly; resume with "
+              "resume_from_checkpoint()")
+        return trainer.state
     finally:
         trainer.close()

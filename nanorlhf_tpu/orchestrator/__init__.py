@@ -4,6 +4,7 @@ sample queue, producer-thread rollout pipeline (docs/ORCHESTRATOR.md)."""
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
 from nanorlhf_tpu.orchestrator.sample_queue import (
     BoundedStalenessQueue,
+    ProducerFailed,
     QueuedSample,
 )
 from nanorlhf_tpu.orchestrator.orchestrator import (
@@ -15,6 +16,7 @@ from nanorlhf_tpu.orchestrator.orchestrator import (
 __all__ = [
     "BoundedStalenessQueue",
     "OverlapMeter",
+    "ProducerFailed",
     "QueuedSample",
     "RolloutOrchestrator",
     "VersionedWeightStore",

@@ -29,6 +29,7 @@ import jax
 
 from nanorlhf_tpu.orchestrator.sample_queue import (
     BoundedStalenessQueue,
+    ProducerFailed,
     QueuedSample,
 )
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
@@ -170,6 +171,7 @@ class RolloutOrchestrator:
         meter: Optional[OverlapMeter] = None,
         restore: Optional[dict] = None,
         heartbeat: float = 30.0,
+        faults=None,
     ):
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
@@ -183,6 +185,8 @@ class RolloutOrchestrator:
         self._dispatch_fn = dispatch_fn
         self._next_index = start_index
         self._heartbeat = heartbeat
+        self._faults = faults  # resilience.FaultInjector ("rollout.produce")
+        self.producer_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce, daemon=True, name="rollout-producer"
@@ -199,6 +203,11 @@ class RolloutOrchestrator:
                 idx = self._next_index
                 if not self.queue.wait_to_produce(idx, self._stop):
                     break
+                if self._faults is not None:
+                    # resilience injection point — BEFORE the dispatch touches
+                    # the data iterator, so a supervised restart redraws from
+                    # an unburned cursor (docs/RESILIENCE.md)
+                    self._faults.fire("rollout.produce")
                 version, tree = self.store.latest()
                 t0 = time.time()
                 payload = self._dispatch_fn(idx, tree)
@@ -211,6 +220,7 @@ class RolloutOrchestrator:
                 self.queue.put(QueuedSample(idx, version, payload, t0, t1))
                 self._next_index += 1
         except BaseException as e:  # surfaces in the consumer's get()
+            self.producer_error = e
             self.queue.fail(e)
 
     # ---------------------------------------------------------------- #
@@ -229,16 +239,30 @@ class RolloutOrchestrator:
         attempts at 2100 s), so the wait only aborts when the producer
         thread is actually DEAD without having reported an error through
         `queue.fail()` (which covers every exception path in `_produce`).
-        The heartbeat interval just bounds how often liveness is checked."""
+        The heartbeat interval just bounds how often liveness is checked.
+        A dead producer raises ProducerFailed (never a silent spin): the
+        queue surfaces the stored terminal exception when one was reported,
+        and a thread that died without reporting (e.g. killed at interpreter
+        teardown before its except clause ran) raises it with whatever
+        `producer_error` holds."""
         while True:
             try:
                 return self.queue.get(timeout=self._heartbeat)
             except TimeoutError:
                 if not self._thread.is_alive():
-                    raise RuntimeError(
+                    raise ProducerFailed(
                         "rollout producer thread died without reporting an "
-                        "error"
-                    ) from None
+                        "error through the queue"
+                    ) from self.producer_error
+
+    def producer_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def consumed_without_update(self) -> None:
+        """A fetched sample was discarded without an optimizer update (a
+        sentinel-quarantined batch): credit the producer gate so the
+        pipeline doesn't deadlock waiting for a publish that never comes."""
+        self.queue.credit_skip()
 
     def publish(self, tree: dict) -> int:
         """Publish a post-update policy snapshot; wakes the producer gate."""

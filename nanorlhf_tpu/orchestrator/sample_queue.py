@@ -33,6 +33,13 @@ import threading
 from typing import Any, Optional
 
 
+class ProducerFailed(RuntimeError):
+    """The producer thread died (its terminal exception is `__cause__`, when
+    it reported one). A dedicated type so the trainer's watchdog can tell a
+    supervisable producer death from an organic consumer-side error —
+    subclassing RuntimeError keeps pre-watchdog callers working."""
+
+
 @dataclasses.dataclass
 class QueuedSample:
     index: int           # rollout index — the data/PRNG cursor position
@@ -104,15 +111,27 @@ class BoundedStalenessQueue:
             self._version = version
             self._cond.notify_all()
 
+    def credit_skip(self) -> None:
+        """The consumer took a sample WITHOUT training on it (a sentinel-
+        quarantined batch): shift the gate's base so the producer may run
+        one more index ahead without a version publish — publishing instead
+        would mislabel every queued sample one version staler than its
+        weights really are (and the "drop" policy would evict them)."""
+        with self._cond:
+            self._base += 1
+            self._cond.notify_all()
+
     def get(self, timeout: Optional[float] = None) -> QueuedSample:
         """Next sample, oldest first; records its staleness in the
-        histogram. Under "drop", over-stale samples are discarded here."""
+        histogram. Under "drop", over-stale samples are discarded here.
+
+        Buffered samples are drained BEFORE a producer failure is raised:
+        samples already in the deque are complete device-ready rollouts
+        produced under the same version arithmetic the consumer is using —
+        discarding them would make every watchdog restart regenerate up to
+        max_staleness+1 rollouts that were never lost."""
         with self._cond:
             while True:
-                if self._error is not None:
-                    raise RuntimeError(
-                        "rollout producer failed"
-                    ) from self._error
                 if self._q:
                     s = self._q.popleft()
                     staleness = self._version - s.version
@@ -126,6 +145,10 @@ class BoundedStalenessQueue:
                     )
                     self._cond.notify_all()
                     return s
+                if self._error is not None:  # buffer drained: surface it
+                    raise ProducerFailed(
+                        "rollout producer failed"
+                    ) from self._error
                 if not self._cond.wait(timeout=timeout):
                     raise TimeoutError(
                         f"no rollout sample after {timeout}s (producer "

@@ -1,0 +1,42 @@
+"""Resilience layer: fault injection, producer watchdog with sync fallback,
+training sentinel with checkpoint rollback, and graceful preemption
+(docs/RESILIENCE.md). jax-free on purpose — every module unit-tests with
+plain Python objects."""
+
+from nanorlhf_tpu.resilience.faults import (
+    ENV_VAR,
+    INJECTION_POINTS,
+    FaultInjector,
+    FaultSchedule,
+    InjectedFault,
+    parse_fault_spec,
+)
+from nanorlhf_tpu.resilience.preemption import Preempted, PreemptionGuard, null_guard
+from nanorlhf_tpu.resilience.procs import reap_process
+from nanorlhf_tpu.resilience.retry import backoff_delay, retry_with_backoff
+from nanorlhf_tpu.resilience.sentinel import (
+    SentinelBudgetExceeded,
+    SentinelConfig,
+    TrainingSentinel,
+)
+from nanorlhf_tpu.resilience.watchdog import ProducerWatchdog, WatchdogConfig
+
+__all__ = [
+    "ENV_VAR",
+    "INJECTION_POINTS",
+    "FaultInjector",
+    "FaultSchedule",
+    "InjectedFault",
+    "Preempted",
+    "PreemptionGuard",
+    "ProducerWatchdog",
+    "SentinelBudgetExceeded",
+    "SentinelConfig",
+    "TrainingSentinel",
+    "WatchdogConfig",
+    "backoff_delay",
+    "null_guard",
+    "parse_fault_spec",
+    "reap_process",
+    "retry_with_backoff",
+]

@@ -1,0 +1,189 @@
+"""Deterministic fault injection for the resilience stack.
+
+Every failure mode the supervision machinery handles (producer crash, NaN
+step, checkpoint-write failure, preemption) must be reproducible in a unit
+test — "it recovered once in prod" is not a test. The registry arms named
+injection points with deterministic schedules; the hosting code calls
+`injector.fire(point)` at the point and the schedule decides whether this
+call fails.
+
+Injection points (wired in trainer/checkpoint/orchestrator dispatch):
+
+    ckpt.save        inside CheckpointManager.save's write attempt
+    ckpt.restore     inside CheckpointManager.restore's read attempt
+    rollout.produce  top of the orchestrator producer's dispatch closure
+                     (before the data iterator is touched, so a restart
+                     redraws from an unburned cursor)
+    reward.exec      inside the trainer's reward-dispatch attempt
+    update.step      after the jitted update's host stats land — `action=nan`
+                     poisons the observed loss/grad-norm instead of raising,
+                     exercising the sentinel exactly like a real NaN step
+
+Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
+by ";" or whitespace):
+
+    point:key=val[,key=val...]
+
+    at=N       fire on the N-th call to this point (1-based; fires once)
+    every=K    fire on every K-th call
+    prob=P     fire each call with probability P under a seeded PRNG
+    seed=S     PRNG seed for prob (default 0 — always deterministic)
+    count=C    cap total fires (default: 1 for `at`, unbounded otherwise)
+    action=A   "raise" (default) raises InjectedFault; "nan" returns "nan"
+               from fire() for the caller to poison its observed value
+
+Examples:
+
+    NANORLHF_FAULT="ckpt.save:at=1"                 first save write fails once
+    NANORLHF_FAULT="rollout.produce:every=1"        every produce attempt dies
+    NANORLHF_FAULT="update.step:at=2,action=nan"    2nd update observes NaN
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+ENV_VAR = "NANORLHF_FAULT"
+
+INJECTION_POINTS = frozenset({
+    "ckpt.save",
+    "ckpt.restore",
+    "rollout.produce",
+    "reward.exec",
+    "update.step",
+})
+
+ACTIONS = ("raise", "nan")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed injection point. Carries the point name so
+    supervision code (and test assertions) can tell injected failures from
+    organic ones."""
+
+    def __init__(self, point: str, detail: str = ""):
+        self.point = point
+        super().__init__(f"injected fault at {point!r}" + (f" ({detail})" if detail else ""))
+
+
+@dataclasses.dataclass
+class FaultSchedule:
+    point: str
+    at: Optional[int] = None
+    every: Optional[int] = None
+    prob: Optional[float] = None
+    seed: int = 0
+    count: Optional[int] = None   # max fires; None = unbounded
+    action: str = "raise"
+    # runtime state
+    calls: int = 0
+    fires: int = 0
+
+    def __post_init__(self):
+        if self.point not in INJECTION_POINTS:
+            raise ValueError(
+                f"unknown injection point {self.point!r}; known: "
+                f"{sorted(INJECTION_POINTS)}"
+            )
+        if self.action not in ACTIONS:
+            raise ValueError(f"action={self.action!r}: {' | '.join(ACTIONS)}")
+        if sum(x is not None for x in (self.at, self.every, self.prob)) != 1:
+            raise ValueError(
+                f"{self.point}: exactly one of at=/every=/prob= required"
+            )
+        if self.count is None and self.at is not None:
+            self.count = 1  # "fire at step N" means once
+        self._rng = np.random.default_rng(self.seed)
+
+    def should_fire(self) -> bool:
+        """Advance this schedule's call counter; True if this call fails."""
+        self.calls += 1
+        if self.count is not None and self.fires >= self.count:
+            return False
+        if self.at is not None:
+            hit = self.calls == self.at
+        elif self.every is not None:
+            hit = self.calls % self.every == 0
+        else:
+            hit = bool(self._rng.random() < self.prob)
+        if hit:
+            self.fires += 1
+        return hit
+
+
+def parse_fault_spec(spec: str) -> list[FaultSchedule]:
+    schedules = []
+    for entry in spec.replace(";", " ").split():
+        if ":" not in entry:
+            raise ValueError(f"fault entry {entry!r}: expected point:key=val,...")
+        point, _, kvs = entry.partition(":")
+        kwargs: dict = {}
+        for kv in kvs.split(","):
+            if "=" not in kv:
+                raise ValueError(f"fault entry {entry!r}: bad clause {kv!r}")
+            k, _, v = kv.partition("=")
+            if k in ("at", "every", "seed", "count"):
+                kwargs[k] = int(v)
+            elif k == "prob":
+                kwargs[k] = float(v)
+            elif k == "action":
+                kwargs[k] = v
+            else:
+                raise ValueError(f"fault entry {entry!r}: unknown key {k!r}")
+        schedules.append(FaultSchedule(point=point, **kwargs))
+    return schedules
+
+
+class FaultInjector:
+    """Thread-safe registry of armed fault schedules.
+
+    `fire(point)` advances every schedule armed on `point`; when one
+    triggers with action "raise" it raises InjectedFault, with action "nan"
+    it returns "nan" for the caller to poison its observation. Returns None
+    when nothing fires — the disarmed fast path is one dict lookup, so
+    production code leaves the calls in unconditionally."""
+
+    def __init__(self, schedules: Optional[list[FaultSchedule]] = None):
+        self._lock = threading.Lock()
+        self._by_point: dict[str, list[FaultSchedule]] = {}
+        for s in schedules or []:
+            self._by_point.setdefault(s.point, []).append(s)
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str] = None) -> "FaultInjector":
+        """Build from an explicit spec string, falling back to the
+        NANORLHF_FAULT env var; empty/None spec arms nothing."""
+        spec = spec if spec is not None else os.environ.get(ENV_VAR)
+        return cls(parse_fault_spec(spec) if spec else None)
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._by_point)
+
+    def fire(self, point: str) -> Optional[str]:
+        schedules = self._by_point.get(point)
+        if not schedules:
+            return None
+        with self._lock:
+            for s in schedules:
+                if s.should_fire():
+                    if s.action == "raise":
+                        raise InjectedFault(point, detail=f"call {s.calls}")
+                    return s.action
+        return None
+
+    def stats(self) -> dict:
+        """{point: {"calls": n, "fires": m}} — test/debug introspection."""
+        with self._lock:
+            out: dict = {}
+            for point, schedules in self._by_point.items():
+                out[point] = {
+                    "calls": sum(s.calls for s in schedules),
+                    "fires": sum(s.fires for s in schedules),
+                }
+            return out

@@ -1,0 +1,39 @@
+"""Bounded retry-with-backoff for host-side I/O (checkpoint writes/reads,
+reward dispatch). Deliberately dumb: synchronous sleep, exponential backoff,
+exception allowlist — supervision layers above decide what failure means."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Type
+
+
+def retry_with_backoff(
+    fn: Callable,
+    attempts: int = 3,
+    backoff_base: float = 0.25,
+    backoff_max: float = 30.0,
+    retry_on: tuple[Type[BaseException], ...] = (Exception,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call `fn()` up to `attempts` times; sleep base·2^k (capped) between
+    tries. `on_retry(attempt_index, exc)` observes each failure that will be
+    retried — the hook where callers count retries into metrics. The final
+    failure propagates unchanged."""
+    if attempts < 1:
+        raise ValueError(f"attempts={attempts} must be >= 1")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(min(backoff_max, backoff_base * (2 ** attempt)))
+
+
+def backoff_delay(attempt: int, base: float, cap: float) -> float:
+    """Exponential backoff schedule shared by the producer watchdog."""
+    return min(cap, base * (2 ** max(0, attempt)))

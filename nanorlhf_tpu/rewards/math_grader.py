@@ -574,8 +574,9 @@ def call_with_timeout(func, *args, timeout: float = 0.5):
         p.start()
     p.join(timeout)
     if p.is_alive():
-        p.terminate()
-        p.join()
+        from nanorlhf_tpu.resilience import reap_process
+
+        reap_process(p)
         _logger.warning(
             "grader timed out after %.3fs — graded False (func=%s)",
             timeout, getattr(func, "__name__", func),

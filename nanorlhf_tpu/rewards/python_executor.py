@@ -20,6 +20,7 @@ import multiprocessing
 import os
 import sys
 import tempfile
+import time
 import traceback
 from dataclasses import dataclass
 from io import StringIO
@@ -38,10 +39,12 @@ def _apply_child_limits(cpu_seconds: int, mem_bytes: int | None):
     giant file writes. Failures are ignored — limits are hardening, not the
     containment boundary.
 
-    RLIMIT_AS is OPT-IN (`mem_bytes`): the child forks from the training
-    process, whose mapped virtual address space (JAX/TPU runtime) routinely
-    exceeds any sane fixed cap — a default AS limit below the inherited
-    mappings would fail every snippet with MemoryError.
+    RLIMIT_AS is OPT-IN (`mem_bytes`): under the (non-default) fork context
+    the child inherits the training process's mapped virtual address space
+    (JAX/TPU runtime), which routinely exceeds any sane fixed cap — a
+    default AS limit below the inherited mappings would fail every snippet
+    with MemoryError. Spawn children are clean, but the default stays
+    opt-in so both contexts behave identically.
     """
     try:
         import resource
@@ -60,7 +63,15 @@ def _apply_child_limits(cpu_seconds: int, mem_bytes: int | None):
 
 
 def _exec_worker(code: str, answer_expr: str | None, q,
-                 cpu_seconds: int = 10, mem_bytes: int | None = None):
+                 cpu_seconds: int = 10, mem_bytes: int | None = None,
+                 ready=None):
+    if ready is not None:
+        # bootstrap fence: under the spawn context the child re-imports the
+        # parent's __main__ before this line runs (seconds, if the launcher
+        # module pulls jax) — the parent starts the snippet's wall-clock
+        # timeout only once this fires, so bootstrap cost is never charged
+        # against the snippet's budget
+        ready.set()
     _apply_child_limits(cpu_seconds, mem_bytes)
     buf = StringIO()
     old_stdout = sys.stdout
@@ -84,27 +95,58 @@ def _exec_worker(code: str, answer_expr: str | None, q,
 
 
 class PythonExecutor:
-    """`run(code)` → ExecutionResult; `timeout` seconds per snippet."""
+    """`run(code)` → ExecutionResult; `timeout` seconds per snippet.
+
+    Children come from the `spawn` multiprocessing context: grader workers
+    run inside the training process, and a fork would duplicate the mapped
+    JAX/TPU runtime state (device handles, the libtpu lock, orbax's async
+    machinery) into a child that then exec's arbitrary model code — the
+    classic fork-after-threads hazard. `spawn` starts from a clean
+    interpreter, BUT its bootstrap re-imports the parent's __main__ module
+    — seconds when training launched via `python -m nanorlhf_tpu.
+    entrypoints.*` (the `__main__` guard stops re-training, not the
+    module-level jax imports). The snippet timeout therefore only starts
+    at the child's ready handshake; `bootstrap_timeout` bounds the respawn
+    itself. Pass mp_context="fork" only in jax-free host tools that need
+    the lower startup latency."""
 
     def __init__(self, timeout: float = 5.0, answer_expr: str | None = None,
-                 cpu_seconds: int = 10, mem_bytes: int | None = None):
+                 cpu_seconds: int = 10, mem_bytes: int | None = None,
+                 mp_context: str = "spawn", term_grace: float = 2.0,
+                 bootstrap_timeout: float = 60.0):
         self.timeout = timeout
         self.answer_expr = answer_expr
         self.cpu_seconds = cpu_seconds
         self.mem_bytes = mem_bytes
+        self.mp_context = mp_context
+        self.term_grace = term_grace
+        self.bootstrap_timeout = bootstrap_timeout
 
     def run(self, code: str) -> ExecutionResult:
-        ctx = multiprocessing.get_context("fork")
+        ctx = multiprocessing.get_context(self.mp_context)
         q = ctx.Queue()
+        ready = ctx.Event()
         p = ctx.Process(
             target=_exec_worker,
-            args=(code, self.answer_expr, q, self.cpu_seconds, self.mem_bytes),
+            args=(code, self.answer_expr, q, self.cpu_seconds, self.mem_bytes,
+                  ready),
         )
         p.start()
+        # bootstrap is metered separately from the snippet (spawn re-import
+        # cost must not eat the grading budget). Poll in short slices with a
+        # liveness check: a child that dies during bootstrap never sets
+        # `ready`, and a blind wait would stall the full bootstrap budget
+        # per snippet; a dead child falls straight through to the result
+        # read below ("no result").
+        deadline = time.monotonic() + self.bootstrap_timeout
+        while (not ready.is_set() and p.is_alive()
+               and time.monotonic() < deadline):
+            ready.wait(0.05)
         p.join(self.timeout)
         if p.is_alive():
-            p.terminate()
-            p.join()
+            from nanorlhf_tpu.resilience import reap_process
+
+            reap_process(p, self.term_grace)
             return ExecutionResult(ok=False, error=f"timeout after {self.timeout}s")
         try:
             status, answer, stdout = q.get(timeout=0.5)

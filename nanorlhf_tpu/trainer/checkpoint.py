@@ -13,6 +13,7 @@ from HBM (async-capable); PRNG key and step go in a JSON trainer state.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import shutil
@@ -20,13 +21,25 @@ import shutil
 import jax
 import numpy as np
 
+from nanorlhf_tpu.resilience.retry import retry_with_backoff
+
 
 class CheckpointManager:
     def __init__(self, output_dir: str, save_total_limit: int = 8,
-                 greater_is_better: bool = True, async_save: bool = True):
+                 greater_is_better: bool = True, async_save: bool = True,
+                 io_retries: int = 2, retry_backoff: float = 0.5,
+                 faults=None):
         self.output_dir = os.path.abspath(output_dir)
         self.save_total_limit = save_total_limit
         self.greater_is_better = greater_is_better
+        # I/O hardening (docs/RESILIENCE.md): io_retries EXTRA attempts with
+        # exponential backoff around each save/restore; retry_count feeds
+        # the resilience/ckpt_retries metric. `faults` is a
+        # resilience.FaultInjector arming ckpt.save / ckpt.restore.
+        self.io_retries = io_retries
+        self.retry_backoff = retry_backoff
+        self.retry_count = 0
+        self._faults = faults
         os.makedirs(self.output_dir, exist_ok=True)
         self._ckpt_dirs: list[str] = self._existing()
         # metric history: step -> metric measured ON that step's saved policy
@@ -45,12 +58,46 @@ class CheckpointManager:
             ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             if async_save else ocp.PyTreeCheckpointer()
         )
+        # exit barrier: a process that returns from main right after the
+        # last step would otherwise abandon the in-flight async write — a
+        # corrupt checkpoint the next resume has to clamp away. close()
+        # unregisters (idempotent to call wait twice anyway).
+        atexit.register(self.wait)
 
     def wait(self):
         """Block until any in-flight async save has committed to disk."""
         fn = getattr(self._ckptr, "wait_until_finished", None)
         if fn is not None:
             fn()
+
+    def _absorb_failed_save(self):
+        """Flush the in-flight async write, ABSORBING a deferred failure
+        from the previous save — the most likely place a real transient
+        checkpoint I/O error surfaces. The failed checkpoint never
+        committed (atomic tree/ rename), so absorption only has to repair
+        the bookkeeping: count the failure, drop the phantom step from
+        dirs/metrics/last-saved (else best_step() can protect — or
+        metric_old be attributed to — a checkpoint with no tree on disk),
+        and remove the partial dir. Every save/recovery path goes through
+        this; the END-of-run flush (train()'s final ckpt.wait) stays raw so
+        a failed FINAL save still surfaces."""
+        try:
+            self.wait()
+        except Exception as e:
+            self.retry_count += 1
+            step = self._last_saved_step
+            path = os.path.join(self.output_dir, f"checkpoint-{step}")
+            if not os.path.isdir(os.path.join(path, "tree")):
+                if path in self._ckpt_dirs:
+                    self._ckpt_dirs.remove(path)
+                shutil.rmtree(path, ignore_errors=True)
+                self._metric_by_step.pop(step, None)
+                committed = [int(d.rsplit("-", 1)[1]) for d in self._ckpt_dirs]
+                self._last_saved_step = max(committed) if committed else None
+                self._save_metric_history()
+            print(f"[checkpoint] previous async save failed "
+                  f"({type(e).__name__}: {e}) — checkpoint {step} not "
+                  f"committed; continuing")
 
     @property
     def _history_path(self) -> str:
@@ -108,15 +155,15 @@ class CheckpointManager:
         if metric_old is not None and self._last_saved_step is not None:
             self._metric_by_step[self._last_saved_step] = float(metric_old)
 
-        self.wait()  # previous async write must commit before we touch disk
+        # previous async write must commit before we touch disk (deferred
+        # failures absorbed — see _absorb_failed_save)
+        self._absorb_failed_save()
         path = os.path.join(self.output_dir, f"checkpoint-{step}")
-        shutil.rmtree(path, ignore_errors=True)
         tree = {"params": params}
         if opt_state is not None:
             tree["opt_state"] = opt_state
         if value_params is not None:
             tree["value"] = value_params
-        self._ckptr.save(os.path.join(path, "tree"), tree)
         state = {"step": step}
         if rng_key is not None:
             import jax.numpy as jnp
@@ -127,8 +174,29 @@ class CheckpointManager:
             ).tolist()
             state["rng_key_typed"] = bool(typed)
         state.update(extra_state or {})
-        with open(os.path.join(path, "trainer_state.json"), "w") as f:
-            json.dump(state, f)
+
+        def attempt():
+            # a failed attempt may have dispatched a partial async write —
+            # flush it (best effort) and clear the target before retrying,
+            # or the retry races its own predecessor's tmp-dir rename
+            if self._faults is not None:
+                self._faults.fire("ckpt.save")
+            shutil.rmtree(path, ignore_errors=True)
+            self._ckptr.save(os.path.join(path, "tree"), tree)
+            with open(os.path.join(path, "trainer_state.json"), "w") as f:
+                json.dump(state, f)
+
+        def on_retry(_attempt, _exc):
+            self.retry_count += 1
+            try:
+                self.wait()
+            except Exception:
+                pass  # the failed write's deferred error must not mask retry
+
+        retry_with_backoff(
+            attempt, attempts=self.io_retries + 1,
+            backoff_base=self.retry_backoff, on_retry=on_retry,
+        )
         if path in self._ckpt_dirs:  # re-saving a step after resume
             self._ckpt_dirs.remove(path)
         self._ckpt_dirs.append(path)
@@ -186,7 +254,19 @@ class CheckpointManager:
           orchestrated alike."""
         self.wait()
         path = os.path.join(self.output_dir, f"checkpoint-{step}", "tree")
-        restored = self._ckptr.restore(path, item=like)
+
+        def attempt():
+            if self._faults is not None:
+                self._faults.fire("ckpt.restore")
+            return self._ckptr.restore(path, item=like)
+
+        def on_retry(_attempt, _exc):
+            self.retry_count += 1
+
+        restored = retry_with_backoff(
+            attempt, attempts=self.io_retries + 1,
+            backoff_base=self.retry_backoff, on_retry=on_retry,
+        )
         import jax.numpy as jnp
         from jax.sharding import SingleDeviceSharding
 
@@ -206,7 +286,7 @@ class CheckpointManager:
         """Drop checkpoints and metric history newer than `step` — called on
         resume-from-an-earlier-step so the abandoned trajectory's saves can't
         hijack latest_step()/best_step() or misattribute the next metric_old."""
-        self.wait()
+        self._absorb_failed_save()
         for d in list(self._ckpt_dirs):
             if int(d.rsplit("-", 1)[1]) > step:
                 shutil.rmtree(d, ignore_errors=True)
@@ -224,7 +304,7 @@ class CheckpointManager:
             return json.load(f)
 
     def latest_step(self) -> int | None:
-        self.wait()
+        self._absorb_failed_save()  # sentinel rollback calls this mid-run
         dirs = self._existing()
         return int(dirs[-1].rsplit("-", 1)[1]) if dirs else None
 
@@ -234,5 +314,9 @@ class CheckpointManager:
         abandoned at teardown is a corrupt checkpoint, and to a successor
         an unflushed save is indistinguishable from a crash mid-save (its
         step gets clamped out of the metric history). `RLTrainer.train()`
-        waits on return and `RLTrainer.close()` calls this."""
+        waits on return and `RLTrainer.close()` calls this. The atexit
+        barrier registered at construction covers processes that exit
+        without closing; unregister it here so a closed manager can't keep
+        the whole tree alive through interpreter shutdown."""
         self.wait()
+        atexit.unregister(self.wait)

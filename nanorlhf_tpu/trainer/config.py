@@ -229,6 +229,42 @@ class RLConfig:
     # docstring for the rollout_ahead interaction.
     rollout_compaction_segments: int = 0
 
+    # ---- resilience (resilience/, docs/RESILIENCE.md) ----
+    # fault-injection spec ("point:at=N,..."); None falls back to the
+    # NANORLHF_FAULT env var; empty arms nothing. Injection points:
+    # ckpt.save, ckpt.restore, rollout.produce, reward.exec, update.step.
+    fault_spec: Optional[str] = None
+    # training sentinel: per-update finite checks on loss/grad-norm plus an
+    # EWMA spike detector; on trip the trainer restores the last committed
+    # checkpoint, quarantines the offending batch, and charges the rollback
+    # budget. Observation-only when healthy: a no-fault run with the
+    # sentinel on is numerically identical to one without it.
+    sentinel: bool = True
+    sentinel_spike_zscore: float = 6.0
+    sentinel_ewma_alpha: float = 0.1
+    sentinel_warmup_steps: int = 20
+    rollback_budget: int = 2
+    # producer watchdog (orchestrated runs): a dead producer thread is
+    # restarted with exponential backoff up to `producer_restart_budget`
+    # CONSECUTIVE failures (a consumed sample resets the streak); past the
+    # budget the run degrades to synchronous rollouts (staleness 0) instead
+    # of dying — unless degrade_to_sync=False, which re-raises.
+    producer_restart_budget: int = 2
+    producer_backoff_base: float = 0.5
+    producer_backoff_max: float = 30.0
+    producer_heartbeat: float = 30.0    # liveness poll interval in get()
+    degrade_to_sync: bool = True
+    # checkpoint I/O hardening: save/restore attempts retried with backoff
+    # (ckpt_io_retries EXTRA attempts after the first). reward_retries
+    # likewise for the host-side reward callable.
+    ckpt_io_retries: int = 2
+    ckpt_retry_backoff: float = 0.5
+    reward_retries: int = 1
+    # SIGTERM → flush in-flight async save, write an emergency checkpoint
+    # at the current step, raise resilience.Preempted (handler installs
+    # only from the main thread; elsewhere this degrades to a no-op guard)
+    graceful_preemption: bool = True
+
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1
     save_total_limit: int = 8

@@ -396,6 +396,20 @@ class SparseGRPOTrainer(RLTrainer):
                     "sparse_skip/raw_score_mean": mean_raw_score,
                     "sparse_skip/rollout_index": self.state["rollouts"],
                 })
+                # preemption must be polled on the skip path too: a long
+                # uniformly-failed/solved streak would otherwise bypass the
+                # bottom-of-loop poll every iteration, swallow SIGTERM, and
+                # be SIGKILLed at the end of the grace window
+                if self._preemption.triggered:
+                    from nanorlhf_tpu.resilience import Preempted
+
+                    self._sparse_save({})
+                    self.ckpt.wait()
+                    raise Preempted(
+                        f"SIGTERM at step {self.state['global_step']} (sparse "
+                        f"skip streak): emergency checkpoint committed to "
+                        f"{cfg.output_dir}"
+                    )
                 continue
             scores, queries_f, responses_f = scores[nz], queries[nz], responses[nz]
             if captured_lp is not None:
@@ -582,14 +596,23 @@ class SparseGRPOTrainer(RLTrainer):
                     raw_scores.reshape(batch_size, n)[rows, keep],
                     cfg.num_printed_samples,
                 )
+            saved_this_step = False
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
-                self.ckpt.save(
-                    self.state["global_step"], self.params,
-                    opt_state=self.opt_state if cfg.save_optimizer_state else None,
-                    rng_key=self.key,
-                    metric_old=metrics.get(cfg.metric_for_best_model),
-                    extra_state={"episode": self.state["episode"],
-                                 "opt_steps": self.state.get("opt_steps", 0)},
+                self._sparse_save(metrics)
+                saved_this_step = True
+            # graceful preemption (docs/RESILIENCE.md): the guard installed
+            # by RLTrainer.__init__ swallows SIGTERM, so this loop MUST poll
+            # it — otherwise a preempted sparse run burns the whole grace
+            # window and is SIGKILLed with no emergency checkpoint
+            if self._preemption.triggered:
+                from nanorlhf_tpu.resilience import Preempted
+
+                if not saved_this_step:
+                    self._sparse_save(metrics)
+                self.ckpt.wait()
+                raise Preempted(
+                    f"SIGTERM at step {self.state['global_step']}: emergency "
+                    f"checkpoint committed to {cfg.output_dir}"
                 )
         # train() returning implies checkpoints are durable (async saver)
         self.ckpt.wait()
@@ -598,3 +621,24 @@ class SparseGRPOTrainer(RLTrainer):
             print(f"exporting HF checkpoint to {cfg.export_hf_dir}")
             self.export_model(cfg.export_hf_dir)
         return self.state
+
+    def _sparse_save(self, metrics: dict):
+        """Sparse-runtime checkpoint — shared by the periodic path and the
+        SIGTERM emergency path. Persists the consumed-rollout cursor (the
+        sparse filter skips updates WITHOUT stepping, so global_step alone
+        under-counts the data/PRNG streams on resume) and the resilience
+        journal, matching the dense runtime's trainer_state contract."""
+        cfg = self.cfg
+        self.ckpt.save(
+            self.state["global_step"], self.params,
+            opt_state=self.opt_state if cfg.save_optimizer_state else None,
+            rng_key=self.key,
+            metric_old=metrics.get(cfg.metric_for_best_model),
+            extra_state={"episode": self.state["episode"],
+                         "opt_steps": self.state.get("opt_steps", 0),
+                         "rollouts": self.state["rollouts"],
+                         "resilience": {
+                             "sentinel": self.sentinel.journal(),
+                             "watchdog": self.watchdog.journal(),
+                         }},
+        )

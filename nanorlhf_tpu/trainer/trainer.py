@@ -175,6 +175,24 @@ class RolloutStream:
     def prefetch(self) -> None:
         self._pending = self.dispatch()
 
+    @property
+    def next_index(self) -> int:
+        """Index the next fetch_or_dispatch() will deliver."""
+        return self._pending["_index"] if self._pending is not None else self._idx
+
+    def skip(self) -> int:
+        """Consume the next data batch WITHOUT dispatching generation — the
+        sentinel quarantined this index, and replaying it would pay a full
+        rollout (the dominant per-step cost) just to discard the result.
+        Only legal with no prefetch pending (an already-dispatched rollout
+        can't be undone — the caller discards it instead)."""
+        assert self._pending is None
+        next(self._t._iter)  # burn the data cursor deterministically
+        idx = self._idx
+        self._idx += 1
+        self._t.state["rollouts"] = self._idx
+        return idx
+
 
 class RLTrainer:
     """Unified online-RL trainer.
@@ -382,8 +400,41 @@ class RLTrainer:
         trainable, _ = self._partition(self._train_tree(self.params, self.value_params))
         self.opt_state = jax.jit(self.optimizer.init)(trainable)
 
+        # ---- resilience layer (resilience/, docs/RESILIENCE.md) ----------
+        from nanorlhf_tpu.resilience import (
+            FaultInjector,
+            PreemptionGuard,
+            ProducerWatchdog,
+            SentinelConfig,
+            TrainingSentinel,
+            WatchdogConfig,
+            null_guard,
+        )
+
+        self.faults = FaultInjector.from_spec(config.fault_spec)
+        self.sentinel = TrainingSentinel(SentinelConfig(
+            enabled=config.sentinel,
+            spike_zscore=config.sentinel_spike_zscore,
+            ewma_alpha=config.sentinel_ewma_alpha,
+            warmup_steps=config.sentinel_warmup_steps,
+            rollback_budget=config.rollback_budget,
+        ))
+        self.watchdog = ProducerWatchdog(WatchdogConfig(
+            restart_budget=config.producer_restart_budget,
+            backoff_base=config.producer_backoff_base,
+            backoff_max=config.producer_backoff_max,
+            degrade_to_sync=config.degrade_to_sync,
+        ))
+        self._preemption = (
+            PreemptionGuard() if config.graceful_preemption else null_guard()
+        )
+
         self.ckpt = CheckpointManager(
-            config.output_dir, config.save_total_limit, config.greater_is_better
+            config.output_dir, config.save_total_limit,
+            config.greater_is_better,
+            io_retries=config.ckpt_io_retries,
+            retry_backoff=config.ckpt_retry_backoff,
+            faults=self.faults,
         )
         self.logger = MetricsLogger(config.output_dir, config.report_to)
         from nanorlhf_tpu.utils.profiling import PhaseTimer
@@ -518,9 +569,36 @@ class RLTrainer:
                 policy=self.cfg.staleness_policy,
                 meter=self._rollout_meter,
                 restore=self._orch_restore_state,
+                heartbeat=self.cfg.producer_heartbeat,
+                faults=self.faults,
             )
             self._orch_restore_state = None
         return self._orchestrator
+
+    def _reset_data_iterator(self):
+        """Rebuild the deterministic loader and fast-forward to the
+        consumed-rollout cursor — shared by resume, producer restart, and
+        the degraded-mode fallback (all three re-draw anything a dead
+        producer may have pulled past the cursor)."""
+        self._iter = self.dataset.loader(self.cfg.batch_size, self.cfg.seed) \
+            if hasattr(self.dataset, "loader") else iter(self.dataset)
+        for _ in range(self.state["rollouts"]):
+            next(self._iter)
+
+    def _restart_producer(self, body: Callable):
+        """Watchdog restart: tear down the dead pipeline, carry the queue's
+        cumulative counters forward, reset the data cursor, and rebuild.
+        The index-keyed generation PRNG + deterministic loader make the
+        redrawn samples' token streams identical to what the dead producer
+        would have delivered (at staleness 0 exactly; at staleness > 0 the
+        redraw may sample from fresher weights — the resume semantics)."""
+        old = self._orchestrator
+        if old is not None:
+            self._orch_restore_state = old.journal()
+            old.close(join_timeout=5.0)
+            self._orchestrator = None
+        self._reset_data_iterator()
+        return self._ensure_orchestrator(body)
 
     def rollout_overlap_frac(self) -> float:
         """Cumulative rollout/train overlap fraction (orchestrator metric;
@@ -787,6 +865,10 @@ class RLTrainer:
             updates, opt_state = optimizer.update(grads, opt_state, trainable)
             trainable = optax.apply_updates(trainable, updates)
             stats = jax.tree.map(jnp.mean, auxes)
+            # global gradient norm: the training sentinel's finite check
+            # reads it, and policy/grad_norm_new is a useful health series
+            # regardless — a scalar reduction, negligible next to the update
+            stats = {**stats, "grad_norm": optax.global_norm(grads)}
             return trainable, opt_state, stats
 
         from functools import partial
@@ -1009,29 +1091,119 @@ class RLTrainer:
                 )
             return {"queries": queries, "gen_out": gen_out, "greedy": greedy}
 
-        use_orch = cfg.rollout_orchestrator
-        if use_orch:
-            orch = self._ensure_orchestrator(rollout_body)
-            stream, meter = None, orch.meter
-        else:
-            orch = None
-            stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
-            meter = stream.meter
-        sample_staleness, queue_depth = 0, 0
-        for update in range(1, n_updates + 1):
-            t_start = time.time()
-            self.state["episode"] += cfg.batch_size
+        from nanorlhf_tpu.orchestrator import ProducerFailed
+        from nanorlhf_tpu.resilience import Preempted, ProducerWatchdog
 
-            # ---- ROLLOUT -------------------------------------------------
-            with self.timer.phase("rollout"):
+        use_orch = False
+        orch, stream, meter = None, None, None
+
+        def ensure_handles():
+            """(Re)build the rollout source after construction, a sentinel
+            rollback (which tears the orchestrator down), or a watchdog
+            degradation (which turns the orchestrated run synchronous)."""
+            nonlocal use_orch, orch, stream, meter
+            use_orch = cfg.rollout_orchestrator and not self.watchdog.degraded
+            if use_orch:
+                orch = self._ensure_orchestrator(rollout_body)
+                stream, meter = None, orch.meter
+            else:
+                orch = None
+                if stream is None:
+                    stream = RolloutStream(
+                        self, rollout_body, meter=self._rollout_meter
+                    )
+                meter = stream.meter
+
+        def degrade_to_sync():
+            """Watchdog budget exhausted: log the mode transition, tear the
+            pipeline down, and fall back to synchronous rollouts (staleness
+            0) from the consumed cursor instead of killing the run."""
+            nonlocal stream
+            print(
+                "[resilience] producer restart budget "
+                f"({cfg.producer_restart_budget}) exhausted — degrading to "
+                "synchronous rollouts (staleness 0)"
+            )
+            if self._orchestrator is not None:
+                # keep the queue's cumulative dropped/staleness counters:
+                # _save_checkpoint journals them from _orch_restore_state in
+                # degraded mode so the metric series stays continuous across
+                # a later resume (the same continuity _restart_producer has)
+                self._orch_restore_state = self._orchestrator.journal()
+                self._orchestrator.close(join_timeout=5.0)
+                self._orchestrator = None
+            self._reset_data_iterator()
+            stream = None  # force a fresh stream at the restored cursor
+            ensure_handles()
+
+        def fetch_sample():
+            """One device-ready rollout, supervised: a dead producer is
+            restarted with backoff up to the watchdog budget (then the run
+            degrades to sync), and sentinel-quarantined batches are consumed
+            and discarded so a post-rollback replay skips the offending
+            data instead of re-deriving the same divergence."""
+            nonlocal orch, sample_staleness, queue_depth
+            while True:
                 if use_orch:
-                    sample = orch.get()
+                    try:
+                        sample = orch.get()
+                    except ProducerFailed as e:
+                        decision, delay = self.watchdog.on_failure()
+                        if decision == ProducerWatchdog.RESTART:
+                            cause = e.__cause__ or e
+                            print(
+                                "[resilience] rollout producer died "
+                                f"({type(cause).__name__}: {cause}) — restart "
+                                f"{self.watchdog.restarts_total} in {delay:.1f}s"
+                            )
+                            time.sleep(delay)
+                            orch = self._restart_producer(rollout_body)
+                            continue
+                        if decision == ProducerWatchdog.DEGRADE:
+                            degrade_to_sync()
+                            continue
+                        raise
+                    self.watchdog.on_success()
                     ro = sample.payload
+                    ro["_index"] = sample.index
                     self.state["rollouts"] = sample.index + 1
                     sample_staleness = orch.version - sample.version
                     queue_depth = orch.queue.depth()
                 else:
+                    # quarantined indices are skipped BEFORE dispatch (zero
+                    # rollout cost) — unless a prefetch already paid for one,
+                    # which the post-fetch discard below handles
+                    while (stream._pending is None
+                           and stream.next_index in self.sentinel.quarantined):
+                        idx = stream.skip()
+                        print(
+                            f"[resilience] skipping quarantined rollout "
+                            f"{idx} (sentinel rollback; not dispatched)"
+                        )
                     ro = stream.fetch_or_dispatch()
+                if ro["_index"] in self.sentinel.quarantined:
+                    # already-generated sample (orchestrated pipeline or a
+                    # serial prefetch): discard it; the producer gate gets a
+                    # skip credit (no version publish)
+                    print(
+                        f"[resilience] skipping quarantined rollout "
+                        f"{ro['_index']} (sentinel rollback)"
+                    )
+                    if use_orch:
+                        orch.consumed_without_update()
+                    continue
+                return ro
+
+        ensure_handles()
+        sample_staleness, queue_depth = 0, 0
+        target_step = self.state["global_step"] + n_updates
+        while self.state["global_step"] < target_step:
+            t_start = time.time()
+
+            # ---- ROLLOUT -------------------------------------------------
+            with self.timer.phase("rollout"):
+                ro = fetch_sample()
+                rollout_index = ro["_index"]
                 if capture:
                     responses, captured_lp = ro["gen_out"]
                     captured_lp = np.asarray(captured_lp)
@@ -1042,9 +1214,11 @@ class RLTrainer:
                 if greedy_responses is not None:
                     greedy_responses.block_until_ready()
             t_busy0 = time.time()  # overlap meter: consumer busy from here
+            self.state["episode"] += cfg.batch_size
             queries = ro["queries"]
             batch_size, context_length = queries.shape
-            if not use_orch and cfg.rollout_ahead and update < n_updates:
+            if (not use_orch and cfg.rollout_ahead
+                    and self.state["global_step"] + 1 < target_step):
                 # dispatch rollout k+1 NOW (from the pre-update-k params, one
                 # update stale): the device generates while the host below
                 # decodes/grades update k's batch
@@ -1058,22 +1232,16 @@ class RLTrainer:
             responses_np = np.asarray(responses)
             responses_decoded = tok.batch_decode(responses_np)
             with self.timer.phase("reward"):
-                scores = np.asarray(
-                    self.reward_func(
-                        [q + r for q, r in zip(question_n, responses_decoded)],
-                        tok.eos_token,
-                    ),
-                    dtype=np.float32,
+                scores = self._dispatch_reward(
+                    [q + r for q, r in zip(question_n, responses_decoded)],
+                    tok.eos_token,
                 )
             log_scores_all = scores.copy()  # raw sampled-rollout scores for logging
             if greedy_responses is not None:
                 greedy_decoded = tok.batch_decode(np.asarray(greedy_responses))
-                greedy_scores = np.asarray(
-                    self.reward_func(
-                        [q + r for q, r in zip(question_strings, greedy_decoded)],
-                        tok.eos_token,
-                    ),
-                    dtype=np.float32,
+                greedy_scores = self._dispatch_reward(
+                    [q + r for q, r in zip(question_strings, greedy_decoded)],
+                    tok.eos_token,
                 )
                 # score − score_greedy is the ReMax advantage seed
                 # (`ReMax/remax_trainer.py:506-513`); raw scores still logged
@@ -1226,6 +1394,29 @@ class RLTrainer:
                 self.params = train_tree["policy"]
                 self.value_params = train_tree.get("value")
                 all_stats = jax.device_get(all_stats)
+            agg = {
+                k: float(np.mean([s[k] for s in all_stats]))
+                for k in (all_stats[0] if all_stats else {})
+            }
+
+            # ---- SENTINEL (resilience/, docs/RESILIENCE.md) ----------------
+            # checked BEFORE the weight-store publish so a tripped step never
+            # feeds poisoned weights to the producer. The update.step fault
+            # poisons the OBSERVED stats (action=nan) — same code path a real
+            # NaN loss/grad takes, without hand-corrupting device arrays.
+            if self.faults.fire("update.step") == "nan":
+                agg["pg_loss"] = float("nan")
+                agg["grad_norm"] = float("nan")
+            verdict = self.sentinel.observe(
+                agg.get("pg_loss", 0.0), agg.get("grad_norm")
+            )
+            if verdict is not None:
+                self._sentinel_rollback(verdict, rollout_index)
+                # the rollback tore the pipeline down and rewound the
+                # data/PRNG cursors — rebuild handles and replay
+                stream = None
+                ensure_handles()
+                continue
             if use_orch:
                 # one version per optimizer update: snapshot the trainable
                 # leaves (donation hazard) and open the producer's gate
@@ -1241,10 +1432,6 @@ class RLTrainer:
             mean_entropy = float(
                 (-np.where(padding_mask, 0.0, logprobs)).sum(1).mean()
             )
-            agg = {
-                k: float(np.mean([s[k] for s in all_stats]))
-                for k in (all_stats[0] if all_stats else {})
-            }
             kl_rollout = float(
                 np.where(padding_mask, 0.0, logprobs - ref_logprobs).sum(1).mean()
             )
@@ -1320,6 +1507,18 @@ class RLTrainer:
                 metrics["offpolicy/is_trunc_frac_new"] = agg.get(
                     "is_trunc_frac", 0.0
                 )
+            # resilience series (docs/RESILIENCE.md): cumulative counters so
+            # dashboards diff them into rates; degraded_mode is the sticky
+            # sync-fallback flag (0 in healthy pipelined runs)
+            metrics.update({
+                "policy/grad_norm_new": agg.get("grad_norm", 0.0),
+                "resilience/producer_restarts": float(
+                    self.watchdog.restarts_total
+                ),
+                "resilience/rollbacks": float(self.sentinel.rollbacks),
+                "resilience/degraded_mode": float(self.watchdog.degraded),
+                "resilience/ckpt_retries": float(self.ckpt.retry_count),
+            })
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
             if self.state["global_step"] % cfg.logging_steps == 0:
@@ -1330,29 +1529,26 @@ class RLTrainer:
                 )
 
             # ---- CHECKPOINT ------------------------------------------------
+            saved_this_step = False
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
-                extra_state = {"episode": self.state["episode"],
-                               "opt_steps": self.state["opt_steps"],
-                               "rollouts": self.state["rollouts"]}
-                if use_orch:
-                    # journal the queue: pending (dispatched, unconsumed)
-                    # indices + cumulative drop/staleness counters. Resume
-                    # re-draws the pending samples from the consumed-rollout
-                    # cursor — the index-keyed PRNG and deterministic loader
-                    # reproduce their token streams (docs/ORCHESTRATOR.md)
-                    extra_state["orchestrator"] = orch.journal()
-                self.ckpt.save(
-                    self.state["global_step"], self.params,
-                    opt_state=self.opt_state if cfg.save_optimizer_state else None,
-                    rng_key=self.key,
-                    metric_old=metrics[cfg.metric_for_best_model]
-                    if cfg.metric_for_best_model in metrics else None,
-                    extra_state=extra_state,
-                    value_params=self.value_params if cfg.save_value_model else None,
-                )
+                self._save_checkpoint(orch if use_orch else None, metrics)
+                saved_this_step = True
             # overlap meter: consumer busy window = everything since the
             # sample was fetched (reward, scoring, update, logging, save)
             meter.note_busy(t_busy0, time.time())
+
+            # ---- PREEMPTION (SIGTERM, docs/RESILIENCE.md) ------------------
+            # polled at the update boundary where state is consistent: flush
+            # the in-flight async save, commit an emergency checkpoint, and
+            # unwind through the launcher's normal close() path
+            if self._preemption.triggered:
+                if not saved_this_step:
+                    self._save_checkpoint(orch if use_orch else None, metrics)
+                self.ckpt.wait()
+                raise Preempted(
+                    f"SIGTERM at step {self.state['global_step']}: emergency "
+                    f"checkpoint committed to {self.cfg.output_dir}"
+                )
 
         # train() returning implies every checkpoint is DURABLE: flush the
         # in-flight async save (saves mid-run overlap training; only this
@@ -1383,6 +1579,100 @@ class RLTrainer:
         if self.cfg.save_value_model and self.value_params is not None:
             like["value"] = self.value_params
         return like
+
+    def _save_checkpoint(self, orch, metrics: dict):
+        """One checkpoint at the current step — the periodic `save_steps`
+        path and the SIGTERM emergency path share it, so an emergency
+        checkpoint is exactly as resumable as a scheduled one."""
+        extra_state = {"episode": self.state["episode"],
+                       "opt_steps": self.state["opt_steps"],
+                       "rollouts": self.state["rollouts"],
+                       # sentinel/watchdog journals: recovery behavior itself
+                       # resumes (rollback spend, quarantined batches,
+                       # restart counters, the degraded-mode flag)
+                       "resilience": {
+                           "sentinel": self.sentinel.journal(),
+                           "watchdog": self.watchdog.journal(),
+                       }}
+        if orch is not None:
+            # journal the queue: pending (dispatched, unconsumed)
+            # indices + cumulative drop/staleness counters. Resume
+            # re-draws the pending samples from the consumed-rollout
+            # cursor — the index-keyed PRNG and deterministic loader
+            # reproduce their token streams (docs/ORCHESTRATOR.md)
+            extra_state["orchestrator"] = orch.journal()
+        elif self._orch_restore_state is not None:
+            # degraded mode: the pipeline is gone but its cumulative
+            # counters must stay journaled, or a resume zeroes the
+            # dropped/staleness series
+            extra_state["orchestrator"] = self._orch_restore_state
+        cfg = self.cfg
+        self.ckpt.save(
+            self.state["global_step"], self.params,
+            opt_state=self.opt_state if cfg.save_optimizer_state else None,
+            rng_key=self.key,
+            metric_old=metrics[cfg.metric_for_best_model]
+            if cfg.metric_for_best_model in metrics else None,
+            extra_state=extra_state,
+            value_params=self.value_params if cfg.save_value_model else None,
+        )
+
+    def _dispatch_reward(self, prompts_and_responses, eos_token) -> np.ndarray:
+        """Reward dispatch with the `reward.exec` injection point and a
+        bounded retry: the reward callable is host-side (subprocess graders,
+        RM inference) and a transient failure there must not kill a TPU
+        run mid-epoch."""
+        from nanorlhf_tpu.resilience import retry_with_backoff
+
+        def attempt():
+            self.faults.fire("reward.exec")
+            return np.asarray(
+                self.reward_func(prompts_and_responses, eos_token),
+                dtype=np.float32,
+            )
+
+        return retry_with_backoff(
+            attempt, attempts=self.cfg.reward_retries + 1, backoff_base=0.1
+        )
+
+    def _sentinel_rollback(self, verdict: str, rollout_index: int):
+        """Sentinel trip (docs/RESILIENCE.md): charge the rollback budget,
+        quarantine the offending rollout index, and restore the last
+        committed checkpoint. The in-memory sentinel/watchdog state is
+        re-applied after the restore — the checkpoint's (older) journal must
+        not erase the trip that triggered this rollback."""
+        step_attempted = self.state["global_step"] + 1
+        last = self.ckpt.latest_step()
+        print(
+            f"[resilience] sentinel tripped ({verdict}) at step "
+            f"{step_attempted} (rollout {rollout_index}) — rolling back to "
+            f"checkpoint {last}"
+        )
+        if last is None:
+            raise RuntimeError(
+                f"sentinel tripped ({verdict}) at step {step_attempted} with "
+                "no committed checkpoint to roll back to — enable save_steps "
+                "or disable cfg.sentinel"
+            )
+        self.sentinel.note_rollback(step_attempted, rollout_index, verdict)
+        keep_sentinel = self.sentinel.journal()
+        keep_watchdog = self.watchdog.journal()
+        # pre-restore statistics rewind with the checkpoint: without this,
+        # replayed healthy steps would be folded into the EWMA twice —
+        # checkpoints without a resilience journal fall back to zeroed stats
+        # (a fresh warmup), which only delays spike detection, never
+        # double-counts
+        self.sentinel.steps, self.sentinel.ewma, self.sentinel.var = 0, 0.0, 0.0
+        self.resume_from_checkpoint(last)
+        # the trip's accounting must survive the restore (the checkpoint
+        # predates it); EWMA stats stay whatever the checkpoint journaled
+        self.sentinel.restore_accounting(keep_sentinel)
+        self.watchdog.restore(keep_watchdog)
+        self.logger.log_event(rollout_index, {
+            "resilience/rollback": 1.0,
+            "resilience/rollback_to_step": float(last),
+            "resilience/rollbacks": float(self.sentinel.rollbacks),
+        })
 
     def resume_from_checkpoint(self, step: Optional[int] = None):
         """Restore params (+ optimizer state, PRNG key, step/episode counters)
@@ -1440,10 +1730,15 @@ class RLTrainer:
         # drop/staleness counters so the metric series stays continuous
         # (pending samples are re-drawn from the rollouts cursor)
         self._orch_restore_state = tstate.get("orchestrator")
-        self._iter = self.dataset.loader(self.cfg.batch_size, self.cfg.seed) \
-            if hasattr(self.dataset, "loader") else iter(self.dataset)
-        for _ in range(self.state["rollouts"]):
-            next(self._iter)
+        # resilience journal: rollback spend, quarantined batches, restart
+        # counters, degraded-mode flag — recovery behavior itself resumes.
+        # (The internal sentinel-rollback path re-applies its own in-memory
+        # state after this restore; see _sentinel_rollback.)
+        res = tstate.get("resilience")
+        if res:
+            self.sentinel.restore(res.get("sentinel", {}))
+            self.watchdog.restore(res.get("watchdog", {}))
+        self._reset_data_iterator()
         return self.state
 
     def export_model(self, out_dir: str, dtype: str = "bfloat16") -> str:
@@ -1465,6 +1760,7 @@ class RLTrainer:
             self._orchestrator = None
         self.ckpt.close()  # flush any in-flight async checkpoint write
         self.logger.close()
+        self._preemption.uninstall()  # restore the previous SIGTERM handler
 
     # ------------------------------------------------------------------ #
     # per-algo advantage assembly (host-side numpy, shapes already fixed)
