@@ -279,7 +279,66 @@ def pallas_on_chip_check(jax) -> dict:
             "decode_check": "ERROR",
             "decode_error": f"{type(e).__name__}: {e}"[:600],
         })
+    try:  # fused hidden→logprob op (ops/fused_logprob.py)
+        result.update(_fused_logprob_check(jax))
+    except Exception as e:
+        result.update({
+            "fused_check": "ERROR",
+            "fused_error": f"{type(e).__name__}: {e}"[:600],
+        })
     return result
+
+
+def _fused_logprob_check(jax) -> dict:
+    """Chunked linear-cross-entropy vs the full-logits oracle: forward
+    logprobs + entropy for BOTH impls (lax chunk scan, Pallas online-lse
+    kernel — non-interpreted on real silicon) and the custom-VJP grads wrt
+    hidden + unembedding for the lax path. Chunk 40 deliberately does not
+    divide the row count; V=2050 does not divide the kernel's vocab block."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.ops.fused_logprob import (
+        fused_logprob, fused_logprob_reference)
+
+    B, T, D, V = 2, 48, 64, 2050
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    h = jax.random.normal(ks[0], (B, T, D), jnp.bfloat16)
+    w = (jax.random.normal(ks[1], (D, V), jnp.float32) * 0.05).astype(jnp.bfloat16)
+    labels = jax.random.randint(ks[2], (B, T), 0, V)
+    temp = 0.9
+
+    ref_lp, ref_ent = fused_logprob_reference(
+        h, w, labels, temp, with_entropy=True)
+    errs = {}
+    for impl in ("lax", "pallas"):
+        lp, ent = fused_logprob(
+            h, w, labels, temp, chunk=40, impl=impl, with_entropy=True)
+        errs[f"fused_{impl}_max_err"] = float(
+            jnp.max(jnp.abs(lp - ref_lp)) + jnp.max(jnp.abs(ent - ref_ent))
+        )
+    # vocab-major orientation ([V, D] + transposed=True) — how tied
+    # embeddings (the Qwen2 default) reach the kernel in production
+    lp_t, ent_t = fused_logprob(
+        h, w.T, labels, temp, chunk=40, impl="pallas", with_entropy=True,
+        transposed=True)
+    errs["fused_transposed_max_err"] = float(
+        jnp.max(jnp.abs(lp_t - ref_lp)) + jnp.max(jnp.abs(ent_t - ref_ent))
+    )
+
+    def g(fn):
+        return jax.jit(jax.grad(
+            lambda h_, w_: (fn(h_, w_) ** 2).sum(), argnums=(0, 1)
+        ))(h, w)
+
+    gf = g(lambda h_, w_: fused_logprob(h_, w_, labels, temp, chunk=40,
+                                        impl="lax"))
+    gr = g(lambda h_, w_: fused_logprob_reference(h_, w_, labels, temp))
+    errs["fused_bwd_max_err"] = max(
+        _rel_err(jnp, a, b) for a, b in zip(gf, gr))
+    tol = 0.02  # bf16 inputs; the kernel's f32 matmul differs by bf16 rounding
+    ok = all(v < tol for v in errs.values())  # compare UNROUNDED errors
+    return {"fused_check": "ok" if ok else "MISMATCH",
+            **{k: round(v, 5) for k, v in errs.items()}}
 
 
 def _rel_err(jnp, a, b):
@@ -540,6 +599,7 @@ def run_bench(jax, init_error):
         return {
             "rollout_quant": r_quant,
             "kv_cache_quant": kv_quant,
+            "fused_logprob": cfg.fused_logprob,
             "rollout_ahead": cfg.rollout_ahead,
             "rollout_orchestrator": orchestrator,
             "max_staleness": staleness if orchestrator else None,
@@ -564,6 +624,12 @@ def run_bench(jax, init_error):
     chosen = measure(rollout_quant, kv_cache_quant, rollout_ahead,
                      orchestrator=orchestrator, staleness=orch_staleness)
     t_baseline = time.time() - t_baseline
+    # peak HBM across the baseline config's updates (fused hidden→logprob
+    # memory trajectory, BENCH_r06 onward; process-cumulative, so captured
+    # BEFORE any sweep configs run). 0.0 on backends without memory stats.
+    from nanorlhf_tpu.trainer.trainer import device_peak_bytes
+
+    peak_bytes_in_use = device_peak_bytes()
     sweep_detail = None
     # the lever config recompiles everything (≈ another baseline's worth of
     # wall time) — skip when that would risk the parent's attempt timeout
@@ -747,6 +813,8 @@ def run_bench(jax, init_error):
         "attention": attention_impl,
         "lora": use_lora,
         "rollout_quant": rollout_quant,
+        "fused_logprob": chosen["fused_logprob"],
+        "peak_bytes_in_use": peak_bytes_in_use,
         "rollout_ahead": chosen["rollout_ahead"],
         "rollout_orchestrator": chosen["rollout_orchestrator"],
         "max_staleness": chosen["max_staleness"],

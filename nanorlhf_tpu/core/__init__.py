@@ -8,6 +8,9 @@ from nanorlhf_tpu.core.model import (
     init_params,
     model_forward,
     padded_forward_logits,
+    padded_forward_hidden,
+    unembedding,
+    unembedding_weight,
     prefill,
     decode_step,
     init_kv_cache,
@@ -23,6 +26,9 @@ __all__ = [
     "init_params",
     "model_forward",
     "padded_forward_logits",
+    "padded_forward_hidden",
+    "unembedding",
+    "unembedding_weight",
     "prefill",
     "decode_step",
     "init_kv_cache",

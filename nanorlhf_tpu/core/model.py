@@ -425,11 +425,36 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
         return x, new_caches
 
 
+def unembedding(config: ModelConfig, params: dict):
+    """`(weight, transposed)` for the fused hidden→logprob op
+    (ops/fused_logprob.py): `(lm_head [D, V], False)`, or
+    `(embed_tokens [V, D], True)` when tied. The tied leaf is handed over
+    UNtransposed on purpose — the op contracts on the shared D axis either
+    way, dW accumulates straight into `embed_tokens`, and its Pallas kernel
+    reads vocab-row blocks; an `embed.T` view feeding a Pallas custom call
+    would make XLA stage the full [D, V] transposed copy (custom-call
+    operands are physical buffers; only XLA dots fold transposes)."""
+    if config.tie_word_embeddings:
+        return params["embed_tokens"], True
+    return params["lm_head"], False
+
+
+def unembedding_weight(config: ModelConfig, params: dict) -> jnp.ndarray:
+    """The [D, V] unembedding matrix: `lm_head`, or `embed_tokens`ᵀ when
+    tied. Under jit the transpose fuses into the consuming XLA matmul (dot
+    dimension numbers), so no transposed copy materializes — and gradients
+    flow back through the transpose to `embed_tokens` unchanged. That
+    folding does NOT hold for Pallas custom calls: anything feeding
+    ops/fused_logprob.py should use `unembedding()` + `transposed=` and
+    skip the view entirely."""
+    if config.tie_word_embeddings:
+        return params["embed_tokens"].T
+    return params["lm_head"]
+
+
 def _logits(config: ModelConfig, params: dict, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["norm"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        return x @ params["embed_tokens"].T
-    return x @ params["lm_head"]
+    return x @ unembedding_weight(config, params)
 
 
 # ---------------------------------------------------------------------------
@@ -527,6 +552,31 @@ def padded_forward_logits(
     if response_context_length is not None:
         x = x[:, response_context_length - 1 : -1]
     return _logits(config, params, x)
+
+
+def padded_forward_hidden(
+    params: dict,
+    config: ModelConfig,
+    query_responses: jnp.ndarray,
+    pad_token_id: int,
+    lora_scale: float = 1.0,
+    remat: bool = False,
+    response_context_length: int | None = None,
+) -> jnp.ndarray:
+    """`padded_forward_logits` minus the vocab projection: FINAL-NORMED
+    hidden states [B, T', D] — the input the fused hidden→logprob op
+    (ops/fused_logprob.py) consumes together with `unembedding_weight`.
+
+    `padded_forward_logits(p, c, qr, ...) ==
+    padded_forward_hidden(p, c, qr, ...) @ unembedding_weight(c, p)` exactly:
+    the response slice happens at the same point (before the head; the final
+    RMSNorm is positionwise, so slicing before or after it is equivalent),
+    and the shift-by-one next-token convention stays in one place.
+    """
+    x = _padded_hidden(params, config, query_responses, pad_token_id, lora_scale, remat)
+    if response_context_length is not None:
+        x = x[:, response_context_length - 1 : -1]
+    return rms_norm(x, params["norm"], config.rms_norm_eps)
 
 
 def init_score_head(config: ModelConfig, key: jax.Array, num_labels: int = 1,

@@ -58,6 +58,8 @@ def main(run_value_init: bool = True, value_init_cfg: ValueInitConfig | None = N
             # regress only the value tree's LoRA partition (`PPO/ppo.py:317-332`)
             value_lora_cfg=trainer.value_lora_cfg,
             key=jax.random.PRNGKey(cfg.seed + 2),
+            # the fused-scoring escape hatch covers this pass too
+            fused_logprob_scoring=cfg.fused_logprob,
         )
 
     return run(cfg, value_params_fn=make_value_params, post_build=value_init_phase)

@@ -1,7 +1,9 @@
 from nanorlhf_tpu.ops.masking import (
     INVALID_LOGPROB,
+    MIN_TEMPERATURE,
     exact_div,
     first_true_indices,
+    guard_temperature,
     truncate_response,
     masked_mean,
     masked_var,
@@ -10,11 +12,19 @@ from nanorlhf_tpu.ops.masking import (
     logprobs_from_logits,
     entropy_from_logits,
 )
+from nanorlhf_tpu.ops.fused_logprob import (
+    chunked_entropy,
+    fused_chunk_rows,
+    fused_logprob,
+    fused_logprob_reference,
+)
 
 __all__ = [
     "INVALID_LOGPROB",
+    "MIN_TEMPERATURE",
     "exact_div",
     "first_true_indices",
+    "guard_temperature",
     "truncate_response",
     "masked_mean",
     "masked_var",
@@ -22,4 +32,8 @@ __all__ = [
     "response_padding_masks",
     "logprobs_from_logits",
     "entropy_from_logits",
+    "chunked_entropy",
+    "fused_chunk_rows",
+    "fused_logprob",
+    "fused_logprob_reference",
 ]

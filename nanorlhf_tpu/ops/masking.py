@@ -116,20 +116,46 @@ def response_padding_masks(responses: jnp.ndarray, sequence_lengths: jnp.ndarray
     return padding_mask, padding_mask_p1
 
 
+# Floor for every temperature division in the repo. ONE constant, ONE guard:
+# the sampler's decode-time logprob capture, the scoring-pass
+# `logprobs_from_logits`, and the update-pass entropy stat previously used
+# three different guards (max(t, 1e-6) / raw t / t + 1e-7), so captured
+# behavior logprobs and scoring logprobs disagreed bit-for-bit at small
+# temperatures — exactly where the IS-ratio math is most sensitive.
+MIN_TEMPERATURE = 1e-6
+
+
+def guard_temperature(temperature):
+    """`max(temperature, MIN_TEMPERATURE)` — the shared division guard.
+
+    Accepts a static python float (sampler/scoring pass the config value,
+    returning a float that folds into the jitted graph as a constant) or a
+    traced array.
+    """
+    if isinstance(temperature, (int, float)):
+        return max(float(temperature), MIN_TEMPERATURE)
+    return jnp.maximum(temperature, MIN_TEMPERATURE)
+
+
 def logprobs_from_logits(
     logits: jnp.ndarray, labels: jnp.ndarray, temperature: float = 1.0
 ) -> jnp.ndarray:
     """log softmax(logits / temperature) gathered at `labels`.
 
     Temperature divides the logits *before* log-softmax, exactly as in the
-    reference logprob pass (`/root/reference/GRPO/grpo_trainer.py:547-549`).
+    reference logprob pass (`/root/reference/GRPO/grpo_trainer.py:547-549`),
+    through the shared `guard_temperature` floor (so sampler-captured and
+    scoring logprobs agree bit-for-bit at any temperature).
 
     Memory-shaped for big vocabularies: computed as
     `logit[label]/T − logsumexp(logits/T)` so no [B, T, V] log-softmax (or
     f32 copy of the logits) is ever materialized — the f32 convert fuses
     into the logsumexp reduction. At Qwen2's 152k vocab this halves the
-    peak HBM of the scoring/update passes. f32 math throughout.
+    peak HBM of the scoring/update passes. f32 math throughout. (The
+    fully-fused path that never sees [B, T, V] logits at all lives in
+    ops/fused_logprob.py.)
     """
+    temperature = guard_temperature(temperature)
     label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     lse = jax.scipy.special.logsumexp(
         logits.astype(jnp.float32) / temperature, axis=-1

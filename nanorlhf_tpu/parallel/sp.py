@@ -239,9 +239,21 @@ def sp_score_logprobs(
     logits cover only the response region (`padded_forward_logits`'s
     `response_context_length` slice); prompt positions have systematically
     lower entropy on a trained model and must not dilute the stat.
+
+    Unaffected by `cfg.fused_logprob` (ops/fused_logprob.py, the dense
+    paths' chunked linear-cross-entropy): the per-shard logits block here is
+    already [B, T/sp, V]-local, reduced to per-token scalars inside the
+    shard_map body before anything global assembles — sequence parallelism
+    IS this path's logits-memory mitigation, scaling with the ring width.
+    Row-chunking the local head would compose with it but only pays off once
+    T/sp alone exceeds the fused chunk budget.
     """
     from nanorlhf_tpu.core.model import padding_inputs
-    from nanorlhf_tpu.ops.masking import entropy_from_logits, logprobs_from_logits
+    from nanorlhf_tpu.ops.masking import (
+        entropy_from_logits,
+        guard_temperature,
+        logprobs_from_logits,
+    )
 
     _, attention_mask, position_ids = padding_inputs(query_responses, pad_token_id)
     attention_mask = attention_mask.astype(jnp.int32)
@@ -265,7 +277,7 @@ def sp_score_logprobs(
         gpos = jax.lax.axis_index(sp_axis) * t_local + jnp.arange(t_local)
         in_span = (gpos >= entropy_from_position) & (gpos < T_global - 1)
         ent_pos = jax.lax.stop_gradient(entropy_from_logits(
-            logits_local.astype(jnp.float32) / (temperature + 1e-7)
+            logits_local.astype(jnp.float32) / guard_temperature(temperature)
         ))                                             # [B, T_local]
         s = jax.lax.psum((ent_pos * in_span[None, :]).sum(), sp_axis)
         c = jax.lax.psum(

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from nanorlhf_tpu.core.config import ModelConfig
 from nanorlhf_tpu.core.model import decode_step, init_kv_cache, prefill
+from nanorlhf_tpu.ops.masking import guard_temperature
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,7 +166,7 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
     """
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    logits = logits.astype(jnp.float32) / guard_temperature(temperature)
     if top_p >= 1.0 or top_k <= 0:
         if top_p < 1.0:
             # exact full-vocab nucleus, sort-free (bisection threshold)
@@ -194,8 +195,10 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
 
 def _token_logprob(logits, tok, temperature):
     """Full-distribution logprob of `tok` at the sampling temperature — the
-    same quantity the scoring pass computes (`logprobs_from_logits`)."""
-    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    same quantity the scoring pass computes (`logprobs_from_logits`), through
+    the SAME `guard_temperature` floor, so captured behavior logprobs and
+    scoring logprobs agree bit-for-bit at small temperatures."""
+    scaled = logits.astype(jnp.float32) / guard_temperature(temperature)
     lse = jax.nn.logsumexp(scaled, axis=-1)
     return jnp.take_along_axis(scaled, tok[..., None], axis=-1)[..., 0] - lse
 

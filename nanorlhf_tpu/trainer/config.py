@@ -197,6 +197,23 @@ class RLConfig:
     value_lora_alpha: int = 16
 
     # ---- memory / kernels ----
+    # fused hidden→logprob scoring (ops/fused_logprob.py, docs/
+    # FUSED_LOGPROB.md): the scoring and update passes compute per-token
+    # logprobs (+ the entropy stat) straight from final hidden states in
+    # row-chunked blocks — the [B, T, V] logits tensor, the single largest
+    # HBM allocation at LLM vocabularies, never materializes, and the
+    # custom-VJP backward recomputes chunk logits instead of saving them.
+    # False keeps the naive full-logits path (parity tests, triage); the
+    # sequence-parallel (sp>1) passes are unaffected either way — they
+    # already shard the head over the ring and never build global logits.
+    fused_logprob: bool = True
+    # rows (flattened microbatch·tokens) per recomputed logits chunk;
+    # None → bytes-budget heuristic (ops/fused_logprob.fused_chunk_rows),
+    # which shrinks the chunk as vocabulary grows so peak stays ≈ constant
+    fused_logprob_chunk: Optional[int] = None
+    # "auto" → Pallas online-logsumexp kernel on TPU, lax chunk scan
+    # elsewhere; "lax" | "pallas" force one (pallas interprets off-TPU)
+    fused_logprob_impl: str = "auto"
     gradient_checkpointing: bool = True
     attention_impl: str = "auto"  # xla | pallas | auto (by seq length, on TPU)
     # remat policy under gradient_checkpointing (core/config.remat_policy):

@@ -40,13 +40,13 @@ from nanorlhf_tpu.algos import (
 from nanorlhf_tpu.algos.losses import grpo_loss
 from nanorlhf_tpu.ops.masking import (
     INVALID_LOGPROB,
-    entropy_from_logits,
     first_true_indices,
     logprobs_from_logits,
     response_padding_masks,
     truncate_response,
 )
 from nanorlhf_tpu.core.model import padded_forward_logits
+from nanorlhf_tpu.ops.fused_logprob import chunked_entropy
 from nanorlhf_tpu.sampler import SamplingParams, generate
 from nanorlhf_tpu.trainer.bucketing import (
     create_batches,
@@ -57,7 +57,9 @@ from nanorlhf_tpu.trainer.bucketing import (
 from nanorlhf_tpu.trainer.trainer import (
     RLTrainer,
     RolloutStream,
+    device_peak_bytes,
     forward_token_budget,
+    fused_response_logprobs,
 )
 
 # forward budget comes from forward_token_budget (activation ∧ vocab caps);
@@ -96,6 +98,15 @@ class SparseGRPOTrainer(RLTrainer):
         pad_id = self.tokenizer.pad_token_id
         lora_scale = self.lora_scale
 
+        if cfg.fused_logprob:
+            # fused hidden→logprob scoring (ops/fused_logprob.py): the
+            # parent's non-sp fused chunk scorer is shape-polymorphic over
+            # bucket widths already (jit per static context_length) — same
+            # closure, one copy, no [rows, T, V] logits block per forward
+            score = self._score_chunk_fn()
+            self._bucket_score_cached = score
+            return score
+
         @partial(jax.jit, static_argnums=(3,))
         def score(params, ref_params, qr, context_length: int):
             resp = qr[:, context_length:]
@@ -126,15 +137,26 @@ class SparseGRPOTrainer(RLTrainer):
 
         def loss_fn(trainable, frozen, mb, context_length, loss_scale):
             tree = combine(trainable, frozen)
-            logits = padded_forward_logits(
-                tree["policy"], mcfg, mb["query_responses"], pad_id,
-                lora_scale=lora_scale, remat=remat,
-                response_context_length=context_length,
-            )
-            entropy = jax.lax.stop_gradient(entropy_from_logits(
-                logits.astype(jnp.float32) / (cfg.temperature + 1e-7)
-            ).mean())
-            new_lp = logprobs_from_logits(logits, mb["responses"], cfg.temperature)
+            if cfg.fused_logprob:
+                new_lp, ent_tok = fused_response_logprobs(
+                    tree["policy"], mcfg, mb["query_responses"],
+                    mb["responses"], pad_id, context_length, cfg,
+                    lora_scale=lora_scale, remat=remat, with_entropy=True,
+                )
+                entropy = jax.lax.stop_gradient(ent_tok.mean())
+            else:
+                logits = padded_forward_logits(
+                    tree["policy"], mcfg, mb["query_responses"], pad_id,
+                    lora_scale=lora_scale, remat=remat,
+                    response_context_length=context_length,
+                )
+                # chunked entropy: no stop-gradient f32 full-logits copy
+                entropy = jax.lax.stop_gradient(chunked_entropy(
+                    logits, cfg.temperature, chunk=cfg.fused_logprob_chunk
+                ).mean())
+                new_lp = logprobs_from_logits(
+                    logits, mb["responses"], cfg.temperature
+                )
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
             loss, aux = grpo_loss(
                 new_lp, mb["logprobs"], mb["ref_logprobs"], mb["advantages"],
@@ -434,11 +456,14 @@ class SparseGRPOTrainer(RLTrainer):
             qr_len = context_length + resp_len
 
             # ---- bucketed logprob pass (budget 22·2316, capped so the
-            # [tokens, vocab] logits block fits HBM) ------------------------
-            rollout_budget = forward_token_budget(self.mcfg.vocab_size)
-            backward_budget = min(
-                BACKWARD_BUDGET, forward_token_budget(self.mcfg.vocab_size) // 2
+            # [tokens, vocab] logits block fits HBM — the cap lifts under
+            # fused_logprob, whose chunking bounds that block itself; NOT
+            # under sp, whose scorer still materializes per-shard logits) ---
+            rollout_budget = forward_token_budget(
+                self.mcfg.vocab_size,
+                fused_logprob=cfg.fused_logprob and not self._sp_on(),
             )
+            backward_budget = min(BACKWARD_BUDGET, rollout_budget // 2)
             buckets = create_batches(qr_len, rollout_budget)
             logprobs = np.full(
                 (len(scores), max_resp), INVALID_LOGPROB, np.float32
@@ -582,6 +607,19 @@ class SparseGRPOTrainer(RLTrainer):
                     agg.get("ratio_mean", 1.0) - 1.0
                 )} if capture else {}),
                 "sec_per_episode": (time.time() - t_start) / cfg.batch_size,
+                # memory series (docs/METRICS.md): saved bytes sized from
+                # this update's WIDEST backward bucket (rows bounded by the
+                # backward token budget at the max bucket width; resp_len /
+                # qr_len are per-row arrays here — variable-length buckets)
+                # — the buffer the fused path avoids per grad microbatch
+                "mem/peak_bytes_in_use": device_peak_bytes(),
+                # 0 on an sp mesh too: the sp grad fn runs there, not fused
+                "mem/logits_bytes_saved": float(
+                    max(1, backward_budget // (context_length + max_resp))
+                    * max_resp * self.mcfg.vocab_size
+                    * jnp.dtype(self.params["embed_tokens"].dtype).itemsize
+                    if cfg.fused_logprob and not self._sp_on() else 0.0
+                ),
                 "episode": self.state["episode"],
             }
             self.state["global_step"] += 1

@@ -54,10 +54,15 @@ from nanorlhf_tpu.algos.losses import (
 )
 from nanorlhf_tpu.core.config import ModelConfig
 from nanorlhf_tpu.core.lora import LoraConfig, init_lora_params, trainable_mask
-from nanorlhf_tpu.core.model import padded_forward_logits, score_forward
+from nanorlhf_tpu.core.model import (
+    padded_forward_hidden,
+    padded_forward_logits,
+    score_forward,
+    unembedding,
+)
+from nanorlhf_tpu.ops.fused_logprob import chunked_entropy, fused_logprob
 from nanorlhf_tpu.ops.masking import (
     INVALID_LOGPROB,
-    entropy_from_logits,
     first_true_indices,
     logprobs_from_logits,
     masked_whiten,
@@ -82,9 +87,64 @@ ACTIVATION_TOKEN_BUDGET = 22 * 2316
 _LOGITS_BYTES_BUDGET = 2 * 1024**3
 
 
-def forward_token_budget(vocab_size: int, bytes_per_elem: int = 2) -> int:
+def forward_token_budget(
+    vocab_size: int, bytes_per_elem: int = 2, fused_logprob: bool = False
+) -> int:
+    """`fused_logprob=True` drops the vocab cap: the fused scorer
+    (ops/fused_logprob.py) never materializes a [tokens, vocab] logits
+    block — its internal chunking bounds that term independently — so the
+    activation budget alone sizes the chunk, and score-pass chunks at LLM
+    vocabularies grow ~8× (the "larger microbatches" half of the fused
+    op's win)."""
+    if fused_logprob:
+        return ACTIVATION_TOKEN_BUDGET
     vocab_cap = max(1024, _LOGITS_BYTES_BUDGET // (vocab_size * bytes_per_elem))
     return min(ACTIVATION_TOKEN_BUDGET, vocab_cap)
+
+
+def fused_response_logprobs(tree, mcfg, query_responses, responses, pad_id,
+                            context_length: int, cfg, lora_scale: float = 1.0,
+                            remat: bool = False, with_entropy: bool = False):
+    """The ONE fused hidden→logprob scorer call (ops/fused_logprob.py):
+    response-position hidden states → per-token logprobs (+ entropy), with
+    the cfg's chunk/impl knobs applied. Shared by the chunked scoring fns,
+    the update-pass microbatch loss, and SparseGRPOTrainer's bucket fns so
+    fused scoring and fused update numerics can never drift apart."""
+    hidden = padded_forward_hidden(
+        tree, mcfg, query_responses, pad_id, lora_scale=lora_scale,
+        remat=remat, response_context_length=context_length,
+    )
+    # tied embeddings ride vocab-major ([V, D] + transposed=True): feeding
+    # the .T view to the op's Pallas kernel would stage a full [D, V]
+    # transposed copy for the custom call
+    w, w_transposed = unembedding(mcfg, tree)
+    return fused_logprob(
+        hidden, w, responses, cfg.temperature,
+        chunk=cfg.fused_logprob_chunk, impl=cfg.fused_logprob_impl,
+        with_entropy=with_entropy, transposed=w_transposed,
+    )
+
+
+def device_peak_bytes() -> float:
+    """Max `peak_bytes_in_use` across local devices — the `mem/peak_bytes_
+    in_use` metric and bench's `detail.peak_bytes_in_use`. 0.0 where the
+    backend reports no memory stats (the CPU test mesh).
+
+    This is the allocator's PROCESS-LIFETIME high-water mark (monotone): it
+    answers "what HBM did this run need", not "what did this phase use" —
+    a rollout/prefill or compile-time spike higher than the update pass
+    dominates the series from then on. The per-phase fused-vs-naive
+    attribution lives in `mem/logits_bytes_saved` (analytic) and the
+    vocab-scaling memory_analysis assertion in tests/test_fused_logprob.py.
+    """
+    peak = 0.0
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        peak = max(peak, float(stats.get("peak_bytes_in_use", 0.0)))
+    return peak
 
 
 def donate_argnums_on_accel(*nums: int) -> tuple:
@@ -727,6 +787,21 @@ class RLTrainer:
                     attn_impl=mcfg.attention_impl,
                 )
                 new_logprobs = new_logprobs[:, context_length - 1 : -1]
+            elif cfg.fused_logprob:
+                # fused hidden→logprob path (ops/fused_logprob.py): the
+                # [micro, T_resp, V] logits block never materializes — the
+                # chunked linear-cross-entropy op emits per-token logprobs
+                # AND the entropy stat in one pass, and its custom-VJP
+                # backward recomputes chunk logits instead of saving them
+                new_logprobs, ent_tok = fused_response_logprobs(
+                    train_tree["policy"], mcfg, mb["query_responses"],
+                    mb["responses"], pad_id, context_length, cfg,
+                    lora_scale=lora_scale, remat=remat, with_entropy=True,
+                )
+                # `policy/entropy_avg_new`, unmasked mean like the reference
+                # (`GRPO/grpo_trainer.py:679-687`); the op's entropy output
+                # already carries stop-gradient semantics
+                entropy = jax.lax.stop_gradient(ent_tok.mean())
             else:
                 logits = padded_forward_logits(
                     train_tree["policy"], mcfg, mb["query_responses"], pad_id,
@@ -735,9 +810,11 @@ class RLTrainer:
                 )
                 # true update-pass entropy over the temperature-scaled logits
                 # — `policy/entropy_avg_new`, unmasked mean like the
-                # reference (`GRPO/grpo_trainer.py:679-687`)
-                entropy = jax.lax.stop_gradient(entropy_from_logits(
-                    logits.astype(jnp.float32) / (cfg.temperature + 1e-7)
+                # reference (`GRPO/grpo_trainer.py:679-687`) — computed
+                # CHUNKED (no stop-gradient f32 full-logits copy; the bf16
+                # logits buffer itself is this naive path's cost)
+                entropy = jax.lax.stop_gradient(chunked_entropy(
+                    logits, cfg.temperature, chunk=cfg.fused_logprob_chunk
                 ).mean())
                 new_logprobs = logprobs_from_logits(
                     logits, mb["responses"], cfg.temperature
@@ -941,6 +1018,26 @@ class RLTrainer:
             self._score_fn_cached = score
             return score
 
+        if cfg.fused_logprob:
+            # fused hidden→logprob scoring: no [chunk, T, V] logits block
+            # for either forward — the rollout-phase scoring chunk size is
+            # no longer bounded by the vocab term of forward_token_budget
+            @partial(jax.jit, static_argnums=(3,))
+            def score(params, ref_params, query_responses, context_length: int):
+                responses = query_responses[:, context_length:]
+                logprobs = fused_response_logprobs(
+                    params, mcfg, query_responses, responses, pad_id,
+                    context_length, cfg, lora_scale=lora_scale,
+                )
+                ref_logprobs = fused_response_logprobs(
+                    ref_params, mcfg, query_responses, responses, pad_id,
+                    context_length, cfg,
+                )
+                return logprobs, ref_logprobs
+
+            self._score_fn_cached = score
+            return score
+
         @partial(jax.jit, static_argnums=(3,))
         def score(params, ref_params, query_responses, context_length: int):
             responses = query_responses[:, context_length:]
@@ -986,6 +1083,14 @@ class RLTrainer:
                     mesh, fsdp_axis=fsdp_axis, lora_scale=lora_scale,
                     attn_impl=mcfg.attention_impl,
                 )[:, context_length - 1 : -1]
+        elif cfg.fused_logprob:
+            @partial(jax.jit, static_argnums=(2,))
+            def score_one(tree, query_responses, context_length: int):
+                return fused_response_logprobs(
+                    tree, mcfg, query_responses,
+                    query_responses[:, context_length:], pad_id,
+                    context_length, cfg, lora_scale=lora_scale,
+                )
         else:
             @partial(jax.jit, static_argnums=(2,))
             def score_one(tree, query_responses, context_length: int):
@@ -1272,9 +1377,15 @@ class RLTrainer:
             # ---- LOGPROB PASS (chunked, jitted) ----------------------------
             qr = np.concatenate([queries_rep, responses_np], axis=1)
             total = qr.shape[0]
+            # the vocab-cap lift only applies when the fused scorer actually
+            # runs — an sp mesh routes scoring through sp_score_logprobs,
+            # which still materializes per-shard [chunk, T/sp, V] logits
             chunk = cfg.local_rollout_forward_batch_size or max(
                 1,
-                forward_token_budget(self.mcfg.vocab_size)
+                forward_token_budget(
+                    self.mcfg.vocab_size,
+                    fused_logprob=cfg.fused_logprob and not self._sp_on(),
+                )
                 // (context_length + cfg.response_length),
             )
             chunk = max(1, min(total, chunk))
@@ -1518,6 +1629,27 @@ class RLTrainer:
                 "resilience/rollbacks": float(self.sentinel.rollbacks),
                 "resilience/degraded_mode": float(self.watchdog.degraded),
                 "resilience/ckpt_retries": float(self.ckpt.retry_count),
+            })
+            # memory series (docs/METRICS.md, docs/FUSED_LOGPROB.md):
+            # peak_bytes_in_use from the backend (0 on CPU), plus the
+            # analytic size of the update-pass full-logits buffer the fused
+            # hidden→logprob path avoids per microbatch (param-dtype logits;
+            # the naive path's old f32 entropy copy is NOT counted — it is
+            # gone in both modes now that the fallback entropy is chunked)
+            n_micro_rows = max(1, mini // cfg.gradient_accumulation_steps)
+            logits_bytes = (
+                n_micro_rows * batch["responses"].shape[1]
+                * self.mcfg.vocab_size
+                * jnp.dtype(self.params["embed_tokens"].dtype).itemsize
+            )
+            metrics.update({
+                "mem/peak_bytes_in_use": device_peak_bytes(),
+                # 0 on an sp mesh too: microbatch_loss takes the sp branch
+                # there and the fused op never runs
+                "mem/logits_bytes_saved": float(
+                    logits_bytes
+                    if cfg.fused_logprob and not self._sp_on() else 0.0
+                ),
             })
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
@@ -1878,7 +2010,13 @@ class RLTrainer:
         return batch, None, reward_info
 
     def _value_pass(self, qr, context_length):
-        """Chunked value prediction (`PPO/ppo_trainer.py:630-634`)."""
+        """Chunked value prediction (`PPO/ppo_trainer.py:630-634`).
+
+        Unaffected by `cfg.fused_logprob`: the value head projects hidden
+        states to [B, T, 1] scores — there is no vocab-sized logits tensor
+        to fuse away, so the naive score_forward IS already the memory-
+        minimal form (same reason the in-update vpred forward stays as-is).
+        """
         total = qr.shape[0]
         # value forward emits [B, T, 1] scores — no vocab-sized logits block —
         # so only the activation-based token budget applies

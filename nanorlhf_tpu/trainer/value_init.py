@@ -23,7 +23,13 @@ import optax
 
 from nanorlhf_tpu.algos import discounted_returns, sparse_terminal_rewards
 from nanorlhf_tpu.core.config import ModelConfig
-from nanorlhf_tpu.core.model import padded_forward_logits, score_forward
+from nanorlhf_tpu.core.model import (
+    padded_forward_hidden,
+    padded_forward_logits,
+    score_forward,
+    unembedding,
+)
+from nanorlhf_tpu.ops.fused_logprob import fused_logprob
 from nanorlhf_tpu.ops.masking import (
     INVALID_LOGPROB,
     first_true_indices,
@@ -68,6 +74,7 @@ def finetune_value_model(
     lora_scale: float = 1.0,
     value_lora_cfg=None,
     key: jax.Array | None = None,
+    fused_logprob_scoring: bool = True,
 ) -> dict:
     """Returns value_params regressed onto the rollout returns.
 
@@ -112,23 +119,36 @@ def finetune_value_model(
     # stand-in policy forward would just double the pass for a zero term
     ref_free = ref_params is None
 
+    # fused hidden→logprob scorer (ops/fused_logprob.py, default chunk/impl
+    # — this helper has no RLConfig to read knobs from): without it this
+    # one-time startup pass would be the last place still allocating the
+    # full [chunk, T, V] logits block at LLM vocabularies.
+    # `fused_logprob_scoring=False` mirrors cfg.fused_logprob=False (the
+    # PPO entrypoint threads it) so the naive-parity escape hatch covers
+    # this pass too.
+    def score_one(p, ids, ctx, scale):
+        resp = ids[:, ctx:]
+        if fused_logprob_scoring:
+            w, w_t = unembedding(model_config, p)
+            return fused_logprob(
+                padded_forward_hidden(p, model_config, ids, pad_id,
+                                      lora_scale=scale,
+                                      response_context_length=ctx),
+                w, resp, temperature, transposed=w_t,
+            )
+        return logprobs_from_logits(
+            padded_forward_logits(p, model_config, ids, pad_id,
+                                  lora_scale=scale,
+                                  response_context_length=ctx),
+            resp, temperature,
+        )
+
     @partial(jax.jit, static_argnums=(3, 4))
     def lp_fn(p, rp, ids, ctx, with_ref: bool):
-        resp = ids[:, ctx:]
-        lp = logprobs_from_logits(
-            padded_forward_logits(p, model_config, ids, pad_id,
-                                  lora_scale=lora_scale,
-                                  response_context_length=ctx),
-            resp, temperature,
-        )
+        lp = score_one(p, ids, ctx, lora_scale)
         if not with_ref:
             return lp, lp
-        rlp = logprobs_from_logits(
-            padded_forward_logits(rp, model_config, ids, pad_id,
-                                  response_context_length=ctx),
-            resp, temperature,
-        )
-        return lp, rlp
+        return lp, score_one(rp, ids, ctx, 1.0)
 
     chunk = max(1, 28 * 2316 // qr.shape[1])
     lps, rlps = [], []
