@@ -32,6 +32,9 @@ BENCH_KV_QUANT (0 | 1: int8 KV cache),
 BENCH_SENTINEL (1: also measure the training sentinel disabled and report
 detail.sentinel.sentinel_overhead_frac — the resilience guard's cost on
 the step wall, docs/RESILIENCE.md),
+BENCH_TELEMETRY (1: also measure with the span tracer enabled and report
+detail.telemetry.telemetry_overhead_frac — the observability acceptance
+gate is < 1% of step wall, docs/OBSERVABILITY.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -53,17 +56,10 @@ BASELINE_EPS_PER_SEC = 1.0  # reference: ~1 s/episode on one A100 40G
 
 _T0 = time.time()  # child-process start (budget accounting for secondaries)
 
-# peak dense bf16 FLOPs/s per chip by device kind (public figures; substring
-# match on jax Device.device_kind). MFU = achieved model FLOPs / peak.
-PEAK_FLOPS = {
-    "v6": 918e12,       # Trillium / v6e
-    "v5p": 459e12,
-    "v5": 197e12,       # v5e / "TPU v5 lite"
-    "v4": 275e12,
-    "v3": 123e12,
-    "v2": 46e12,
-}
-CPU_PEAK_FLOPS = 1e12   # nominal; CPU-fallback MFU is not meaningful
+# Peak-FLOPs table and the napkin model-FLOPs/MFU formula live in
+# nanorlhf_tpu/telemetry/mfu.py — ONE accounting shared with the trainer's
+# per-update `perf/mfu` series, imported in the measurement child
+# (mfu.py is jax-free at module level, so the import is safe there).
 
 
 def _emit(payload: dict) -> None:
@@ -502,17 +498,11 @@ def run_bench(jax, init_error):
         n_prompts = min(n_prompts, 8)
         response_len = min(response_len, 64)
 
+    from nanorlhf_tpu.telemetry.mfu import peak_flops_per_chip, update_flops
+
     n_dev = len(jax.devices())
     device_kind = jax.devices()[0].device_kind
-    peak = CPU_PEAK_FLOPS
-    peak_known = False
-    if backend == "tpu":
-        for k, v in PEAK_FLOPS.items():
-            if k in device_kind.lower().replace(" ", ""):
-                peak, peak_known = v, True
-                break
-        if not peak_known:
-            peak = PEAK_FLOPS["v5"]
+    peak, peak_known = peak_flops_per_chip(device_kind, backend)
 
     mcfg = (
         ModelConfig.qwen2_1_5b() if model_name == "1_5b"
@@ -541,7 +531,8 @@ def run_bench(jax, init_error):
                                   max_prompt_len=64)
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
-                orchestrator=False, staleness=2, sentinel=True):
+                orchestrator=False, staleness=2, sentinel=True,
+                telemetry=False):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -573,6 +564,7 @@ def run_bench(jax, init_error):
             rollout_orchestrator=orchestrator,
             max_staleness=staleness,
             sentinel=sentinel,
+            telemetry=telemetry,
             kv_cache_quant=kv_quant,
             gradient_checkpointing=True,
             mesh=MeshConfig(n_dev, 1, 1),
@@ -733,6 +725,35 @@ def run_bench(jax, init_error):
         except Exception as e:
             sentinel_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # telemetry-overhead A/B (docs/OBSERVABILITY.md acceptance: the span
+    # tracer + flight recorder + perf accounting cost < 1% of step wall
+    # when enabled): re-measure the chosen config with cfg.telemetry on.
+    # Compiled executables are config-identical, so the re-run is cheap
+    # relative to a lever sweep; still gated on remaining budget.
+    telemetry_detail = None
+    if (os.environ.get("BENCH_TELEMETRY", "1") == "1"
+            and budget - (time.time() - _T0) > 0.9 * t_baseline):
+        try:
+            tele_on = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"],
+                capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
+                telemetry=True,
+            )
+            on_sec = tele_on["sec_per_update_steady"]
+            telemetry_detail = {
+                "off_sec_per_update": chosen["sec_per_update_steady"],
+                "on_sec_per_update": on_sec,
+                "telemetry_overhead_frac": round(
+                    (on_sec - chosen["sec_per_update_steady"])
+                    / max(chosen["sec_per_update_steady"], 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            telemetry_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # secondary short-response point (the r1/r2 rounds' resp-256 shape) so
     # the payload carries BOTH operating points — the resp-1500 headline
     # stays baseline-comparable and the short point tracks decode-lever
@@ -791,11 +812,11 @@ def run_bench(jax, init_error):
     score_forwards = 1 if chosen["sampler_logprob_capture"] else 2
     score_tokens = score_forwards * episodes_per_update * seq_len
     train_tokens = 1 * episodes_per_update * seq_len    # num_ppo_epochs = 1
-    fwd = 2.0 * n_params                                # FLOPs per token fwd
-    flops_per_update = (
-        (decode_tokens + prefill_tokens) * fwd
-        + score_tokens * fwd
-        + train_tokens * 3.0 * fwd                      # fwd + bwd ≈ 3× fwd
+    # telemetry/mfu.py: forward-only tokens at 2N, trained at 3·2N — the
+    # same formula behind the trainer's per-update perf/mfu metric
+    flops_per_update = update_flops(
+        n_params, decode_tokens=decode_tokens, prefill_tokens=prefill_tokens,
+        score_tokens=score_tokens, train_tokens=train_tokens,
     )
     mfu = flops_per_update / sec_per_update / (peak * n_dev)
     tokens_per_sec = (
@@ -840,6 +861,8 @@ def run_bench(jax, init_error):
         detail["sweep"] = sweep_detail
     if sentinel_detail is not None:
         detail["sentinel"] = sentinel_detail
+    if telemetry_detail is not None:
+        detail["telemetry"] = telemetry_detail
     if short_detail is not None:
         detail["short_response"] = short_detail
     if init_error is not None:

@@ -143,3 +143,10 @@ def run(cfg: RLConfig, value_params_fn=None, post_build=None):
         return trainer.state
     finally:
         trainer.close()
+        if cfg.telemetry:
+            # close() just (re)wrote the span trace — point the operator at
+            # it (docs/OBSERVABILITY.md)
+            trace = os.path.join(cfg.telemetry_dir or cfg.output_dir,
+                                 "trace.json")
+            print(f"[telemetry] span trace: {trace} — load at "
+                  "https://ui.perfetto.dev")

@@ -21,6 +21,7 @@ checkpoint/resume journal (docs/ORCHESTRATOR.md).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Optional
@@ -135,11 +136,20 @@ class OverlapMeter:
         return min(1.0, max(0.0, overlap / total))
 
 
-def note_ready_async(meter: OverlapMeter, payload, t0: float) -> None:
+def note_ready_async(meter: OverlapMeter, payload, t0: float,
+                     tracer=None, span_args: Optional[dict] = None) -> None:
     """Record [t0, device-ready] into `meter` without blocking the caller —
     a daemon waiter thread block_until_ready's the (async-dispatched)
     payload. Lets the synchronous RolloutStream report honest generation
-    busy windows for the same overlap metric the orchestrator emits."""
+    busy windows for the same overlap metric the orchestrator emits.
+
+    With a telemetry.SpanTracer the same window is also recorded as a
+    `rollout.generate` ASYNC trace event on the "rollout" track (explicit
+    start/duration; async because rollout_ahead's prefetch makes
+    consecutive windows overlap, which complete "X" spans on one track
+    cannot express) — so serial / rollout_ahead runs show their generation
+    lane in trace.json just like orchestrated runs do."""
+    tp0 = tracer.now_us() if tracer is not None and tracer.enabled else None
 
     def _wait():
         try:
@@ -147,6 +157,13 @@ def note_ready_async(meter: OverlapMeter, payload, t0: float) -> None:
         except Exception:
             return  # the consumer surfaces dispatch errors; meter stays silent
         meter.note_gen(t0, time.time())
+        if tp0 is not None:
+            args = span_args or {}
+            tracer.add_async(
+                "rollout.generate", tp0, tracer.now_us() - tp0,
+                aid=args.get("rollout_index", id(payload)), track="rollout",
+                **args,
+            )
 
     threading.Thread(target=_wait, daemon=True,
                      name="rollout-ready-watch").start()
@@ -172,6 +189,7 @@ class RolloutOrchestrator:
         restore: Optional[dict] = None,
         heartbeat: float = 30.0,
         faults=None,
+        tracer=None,
     ):
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
@@ -186,6 +204,9 @@ class RolloutOrchestrator:
         self._next_index = start_index
         self._heartbeat = heartbeat
         self._faults = faults  # resilience.FaultInjector ("rollout.produce")
+        # telemetry.SpanTracer: generation spans land on the producer
+        # thread's own track — the trainer-vs-producer overlap picture
+        self._tracer = tracer
         self.producer_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -209,15 +230,28 @@ class RolloutOrchestrator:
                     # an unburned cursor (docs/RESILIENCE.md)
                     self._faults.fire("rollout.produce")
                 version, tree = self.store.latest()
+                tr = self._tracer
+                span = (
+                    # the producer is one long-lived thread, so the span
+                    # lands on its own trace.json track — the generation
+                    # lane of the producer/trainer overlap picture
+                    tr.span("rollout.generate", rollout_index=idx,
+                            policy_version=version)
+                    if tr is not None and tr.enabled
+                    else contextlib.nullcontext()
+                )
                 t0 = time.time()
-                payload = self._dispatch_fn(idx, tree)
-                # block HERE (producer thread): the consumer receives
-                # device-ready samples, and [t0, t1] is the true
-                # generation busy window for the overlap meter
-                jax.block_until_ready(payload)
+                with span:
+                    payload = self._dispatch_fn(idx, tree)
+                    # block HERE (producer thread): the consumer receives
+                    # device-ready samples, and [t0, t1] is the true
+                    # generation busy window for the overlap meter
+                    jax.block_until_ready(payload)
                 t1 = time.time()
                 self.meter.note_gen(t0, t1)
                 self.queue.put(QueuedSample(idx, version, payload, t0, t1))
+                if tr is not None and tr.enabled:
+                    tr.counter("orchestrator/queue_depth", self.queue.depth())
                 self._next_index += 1
         except BaseException as e:  # surfaces in the consumer's get()
             self.producer_error = e
@@ -277,6 +311,11 @@ class RolloutOrchestrator:
             "queue_depth": self.queue.depth(),
             "dropped": self.queue.dropped,
             "staleness_counts": dict(self.queue.staleness_counts),
+            # who-waits-on-whom (sample_queue.py): trainer starved vs
+            # producer gated — the two numbers that say which side of the
+            # pipeline is the bottleneck (docs/OBSERVABILITY.md)
+            "consumer_wait_s": self.queue.consumer_wait_s,
+            "producer_gate_wait_s": self.queue.producer_gate_wait_s,
         }
 
     def journal(self) -> dict:

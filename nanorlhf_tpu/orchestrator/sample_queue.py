@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from typing import Any, Optional
 
 
@@ -67,6 +68,14 @@ class BoundedStalenessQueue:
         # ---- metrics (cumulative; resume seeds them from the journal) ----
         self.dropped = 0
         self.staleness_counts: dict[int, int] = {}
+        # who-waits-on-whom diagnostics (cumulative seconds, perf_counter;
+        # per-process — not journaled): consumer_wait_s = trainer starved
+        # for samples (pipeline too shallow / generation too slow),
+        # producer_gate_wait_s = producer blocked on the staleness gate or
+        # queue capacity (training is the bottleneck — the healthy state).
+        # Surfaced via orchestrator.stats() and the telemetry counters.
+        self.consumer_wait_s = 0.0
+        self.producer_gate_wait_s = 0.0
 
     # ---------------------------------------------------------------- #
     # producer side
@@ -87,7 +96,9 @@ class BoundedStalenessQueue:
                 )
                 if gate_open and len(self._q) < self.maxsize:
                     return True
+                t0 = time.perf_counter()
                 self._cond.wait(timeout=0.1)
+                self.producer_gate_wait_s += time.perf_counter() - t0
             return False
 
     def put(self, sample: QueuedSample) -> None:
@@ -149,7 +160,10 @@ class BoundedStalenessQueue:
                     raise ProducerFailed(
                         "rollout producer failed"
                     ) from self._error
-                if not self._cond.wait(timeout=timeout):
+                t0 = time.perf_counter()
+                ok = self._cond.wait(timeout=timeout)
+                self.consumer_wait_s += time.perf_counter() - t0
+                if not ok:
                     raise TimeoutError(
                         f"no rollout sample after {timeout}s (producer "
                         "stalled?)"

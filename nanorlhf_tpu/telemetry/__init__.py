@@ -1,0 +1,35 @@
+"""Telemetry subsystem (docs/OBSERVABILITY.md): cross-thread span tracing
+with a flight-recorder ring (tracer.py — Chrome trace-event JSON, Perfetto-
+loadable), and analytic MFU/throughput accounting with a jax.monitoring
+recompile counter (mfu.py). tracer.py is jax-free; mfu.py imports jax
+lazily — bench's jax-averse parent can load either by file path."""
+
+from nanorlhf_tpu.telemetry.mfu import (
+    BACKEND_COMPILE_EVENT,
+    CPU_PEAK_FLOPS,
+    PEAK_FLOPS_PER_CHIP,
+    RecompileCounter,
+    flops_param_count,
+    peak_flops_per_chip,
+    recompile_counter,
+    update_flops,
+)
+from nanorlhf_tpu.telemetry.tracer import (
+    SpanTracer,
+    validate_trace_events,
+    validate_trace_file,
+)
+
+__all__ = [
+    "BACKEND_COMPILE_EVENT",
+    "CPU_PEAK_FLOPS",
+    "PEAK_FLOPS_PER_CHIP",
+    "RecompileCounter",
+    "SpanTracer",
+    "flops_param_count",
+    "peak_flops_per_chip",
+    "recompile_counter",
+    "update_flops",
+    "validate_trace_events",
+    "validate_trace_file",
+]

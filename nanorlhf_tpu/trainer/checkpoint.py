@@ -14,6 +14,7 @@ from HBM (async-capable); PRNG key and step go in a JSON trainer state.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import json
 import os
 import shutil
@@ -28,7 +29,7 @@ class CheckpointManager:
     def __init__(self, output_dir: str, save_total_limit: int = 8,
                  greater_is_better: bool = True, async_save: bool = True,
                  io_retries: int = 2, retry_backoff: float = 0.5,
-                 faults=None):
+                 faults=None, tracer=None):
         self.output_dir = os.path.abspath(output_dir)
         self.save_total_limit = save_total_limit
         self.greater_is_better = greater_is_better
@@ -40,6 +41,10 @@ class CheckpointManager:
         self.retry_backoff = retry_backoff
         self.retry_count = 0
         self._faults = faults
+        # telemetry.SpanTracer (docs/OBSERVABILITY.md): save/restore get
+        # spans on a dedicated "ckpt" track — checkpoint I/O stalls are a
+        # classic silent step-time eater
+        self._tracer = tracer
         os.makedirs(self.output_dir, exist_ok=True)
         self._ckpt_dirs: list[str] = self._existing()
         # metric history: step -> metric measured ON that step's saved policy
@@ -63,6 +68,12 @@ class CheckpointManager:
         # corrupt checkpoint the next resume has to clamp away. close()
         # unregisters (idempotent to call wait twice anyway).
         atexit.register(self.wait)
+
+    def _span(self, name: str, **args):
+        """Trace span on the "ckpt" track; nullcontext when untraced."""
+        if self._tracer is None or not self._tracer.enabled:
+            return contextlib.nullcontext({})
+        return self._tracer.span(name, track="ckpt", **args)
 
     def wait(self):
         """Block until any in-flight async save has committed to disk."""
@@ -193,10 +204,14 @@ class CheckpointManager:
             except Exception:
                 pass  # the failed write's deferred error must not mask retry
 
-        retry_with_backoff(
-            attempt, attempts=self.io_retries + 1,
-            backoff_base=self.retry_backoff, on_retry=on_retry,
-        )
+        # the span covers the BLOCKING part of an async save (device→host
+        # copy + write dispatch); the streaming tail runs off-thread and
+        # surfaces in the NEXT save's wait if it stalls
+        with self._span("ckpt.save", step=step):
+            retry_with_backoff(
+                attempt, attempts=self.io_retries + 1,
+                backoff_base=self.retry_backoff, on_retry=on_retry,
+            )
         if path in self._ckpt_dirs:  # re-saving a step after resume
             self._ckpt_dirs.remove(path)
         self._ckpt_dirs.append(path)
@@ -263,10 +278,11 @@ class CheckpointManager:
         def on_retry(_attempt, _exc):
             self.retry_count += 1
 
-        restored = retry_with_backoff(
-            attempt, attempts=self.io_retries + 1,
-            backoff_base=self.retry_backoff, on_retry=on_retry,
-        )
+        with self._span("ckpt.restore", step=step):
+            restored = retry_with_backoff(
+                attempt, attempts=self.io_retries + 1,
+                backoff_base=self.retry_backoff, on_retry=on_retry,
+            )
         import jax.numpy as jnp
         from jax.sharding import SingleDeviceSharding
 

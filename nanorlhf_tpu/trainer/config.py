@@ -282,6 +282,35 @@ class RLConfig:
     # only from the main thread; elsewhere this degrades to a no-op guard)
     graceful_preemption: bool = True
 
+    # ---- telemetry (telemetry/, docs/OBSERVABILITY.md) ----
+    # span tracer + flight recorder: records named spans with correlation
+    # args (step, rollout_index, staleness, policy_version) on per-thread
+    # tracks — trainer loop, orchestrator producer, reward dispatch,
+    # checkpoint I/O — and writes a Perfetto-loadable Chrome trace
+    # (`<telemetry_dir>/trace.json`) at the end of every train() call and
+    # on close(). The resilience layer dumps the flight-recorder ring as
+    # `blackbox_<step>.json` on sentinel trip / producer failure / SIGTERM.
+    # Off by default; the bench A/B (detail.telemetry) holds the enabled
+    # overhead under 1% of step wall.
+    telemetry: bool = False
+    telemetry_dir: Optional[str] = None     # None -> output_dir
+    # bounded trace buffer: events past the cap are dropped (counted in the
+    # telemetry/spans_dropped metric) so a long run cannot OOM the host
+    telemetry_max_events: int = 200_000
+    flight_recorder_len: int = 256          # blackbox ring: recent spans kept
+    # windowed XLA profiling (utils/profiling.ProfileWindow): wrap
+    # jax.profiler around exactly [profile_at_step, +profile_num_steps)
+    # updates, writing a TensorBoard-loadable trace to profile_dir
+    # (None -> <output_dir>/profile). Independent of `telemetry` — the XLA
+    # profile answers "what did the compiler run", the span trace answers
+    # "what did the host pipeline do". An on-demand window can be requested
+    # on a live run by touching the trigger file (None -> <output_dir>/
+    # PROFILE; the file is consumed when the window opens).
+    profile_at_step: Optional[int] = None
+    profile_num_steps: int = 1
+    profile_dir: Optional[str] = None
+    profile_trigger_file: Optional[str] = None
+
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1
     save_total_limit: int = 8

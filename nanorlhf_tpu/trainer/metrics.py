@@ -10,6 +10,7 @@ is a JSONL file any dashboard can tail.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import time
@@ -48,6 +49,12 @@ class MetricsLogger:
             except Exception as e:
                 print(f"[metrics] tensorboard unavailable ({type(e).__name__}); "
                       "jsonl only")
+        if self._fh is not None or self._tb is not None:
+            # abnormal exits (unhandled exception, sys.exit from a harness)
+            # bypass trainer.close(): without this barrier the TB writer's
+            # buffered events — and any unflushed JSONL tail — are lost with
+            # the process. close() unregisters; double-close is a no-op.
+            atexit.register(self.close)
 
     def _emit(self, prefix: str, x: int, extra: dict, metrics: dict):
         record = {"step": x, **extra, "time": time.time()}
@@ -91,7 +98,13 @@ class MetricsLogger:
             self._fh.flush()
 
     def close(self):
+        """Flush + close both sinks. Idempotent (also runs as the atexit
+        barrier registered at construction — a second call finds the
+        handles already None)."""
+        atexit.unregister(self.close)
         if self._fh:
             self._fh.close()
+            self._fh = None
         if self._tb:
             self._tb.close()
+            self._tb = None

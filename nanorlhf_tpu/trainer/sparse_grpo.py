@@ -361,9 +361,15 @@ class SparseGRPOTrainer(RLTrainer):
         stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
         for update in range(1, n_updates + 1):
             t_start = time.time()
+            step_t0 = time.perf_counter()
+            # telemetry (docs/OBSERVABILITY.md): profile-window poll + the
+            # per-update span, same contract as the dense loop
+            self.profile_window.poll(self.state["global_step"] + 1)
+            span_t0 = self.tracer.now_us() if self.tracer.enabled else 0.0
             self.state["episode"] += cfg.batch_size
 
             # ---- rollout + reward -----------------------------------------
+            t_roll0 = time.perf_counter()
             ro = stream.fetch_or_dispatch()
             queries = ro["queries"]
             batch_size = queries.shape[0]
@@ -374,6 +380,7 @@ class SparseGRPOTrainer(RLTrainer):
             else:
                 responses = np.asarray(ro["gen_out"])
                 captured_lp = None
+            rollout_s = time.perf_counter() - t_roll0
             if cfg.rollout_ahead and update < n_updates:
                 # overlap the NEXT generation with this update's grading —
                 # in the r1 path the sympy/subprocess graders are the
@@ -407,6 +414,12 @@ class SparseGRPOTrainer(RLTrainer):
             kept_frac = len(nz) / max(batch_size, 1)
             if len(nz) == 0:
                 print(f"[sparse-grpo] update {update}: all advantages zero, skipping")
+                # skip marker in the trace: a starved streak shows up as a
+                # row of instants instead of a silent gap
+                self.tracer.instant(
+                    "sparse.skip", rollout_index=self.state["rollouts"],
+                    raw_score_mean=mean_raw_score,
+                )
                 # a metrics row even for the skip (the reference logs
                 # nothing here): with sparse/binary rewards, WHY training
                 # starves matters — raw_score_mean 0 = uniformly failed,
@@ -427,6 +440,11 @@ class SparseGRPOTrainer(RLTrainer):
 
                     self._sparse_save({})
                     self.ckpt.wait()
+                    self.tracer.dump_blackbox(
+                        self._telemetry_dir, self.state["global_step"],
+                        "preemption",
+                    )
+                    self._write_trace()
                     raise Preempted(
                         f"SIGTERM at step {self.state['global_step']} (sparse "
                         f"skip streak): emergency checkpoint committed to "
@@ -517,6 +535,7 @@ class SparseGRPOTrainer(RLTrainer):
             advantages = np.where(padding_mask, 0.0, advantages)
 
             # ---- bucketed update (budget 4·2316, loss-scaled) -------------
+            t_upd0 = time.perf_counter()
             trainable, frozen = self._partition(
                 self._train_tree(self.params, self.value_params)
             )
@@ -570,6 +589,7 @@ class SparseGRPOTrainer(RLTrainer):
                     self.state["opt_steps"] = self.state.get("opt_steps", 0) + 1
             self.params = self._combine(trainable, frozen)["policy"]
             all_stats = jax.device_get(all_stats)
+            update_s = time.perf_counter() - t_upd0
 
             # ---- metrics / eval / checkpoint ------------------------------
             agg = {
@@ -622,6 +642,24 @@ class SparseGRPOTrainer(RLTrainer):
                 ),
                 "episode": self.state["episode"],
             }
+            # perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md): the
+            # dense loop's napkin model with sparse-runtime token counts —
+            # scoring/update tokens count only the KEPT (post-filter) rows
+            score_forwards = (
+                0 if (ref_free and capture)
+                else 1 if (ref_free or capture) else 2
+            )
+            metrics.update(self._perf_metrics(
+                step_wall_s=time.perf_counter() - step_t0,
+                decode_tokens=batch_size * n * cfg.response_length,
+                prefill_tokens=batch_size * n * queries.shape[1],
+                score_tokens=score_forwards * len(scores)
+                * (context_length + max_resp),
+                train_tokens=cfg.num_ppo_epochs * local_bs
+                * (context_length + max_resp),
+                rollout_s=rollout_s,
+                update_s=update_s,
+            ))
             self.state["global_step"] += 1
             if self.accuracy_func is not None and cfg.eval_steps and \
                     self.state["global_step"] % cfg.eval_steps == 0:
@@ -638,6 +676,17 @@ class SparseGRPOTrainer(RLTrainer):
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
                 self._sparse_save(metrics)
                 saved_this_step = True
+            if self.tracer.enabled:
+                # staleness is structurally 0 here (the sparse loop rejects
+                # the orchestrator); kept_rows is the sparse-specific
+                # correlation arg
+                self.tracer.add_complete(
+                    "train.update", span_t0, self.tracer.now_us() - span_t0,
+                    step=self.state["global_step"],
+                    rollout_index=ro["_index"], staleness=0,
+                    policy_version=self.state["global_step"],
+                    kept_rows=local_bs,
+                )
             # graceful preemption (docs/RESILIENCE.md): the guard installed
             # by RLTrainer.__init__ swallows SIGTERM, so this loop MUST poll
             # it — otherwise a preempted sparse run burns the whole grace
@@ -648,12 +697,21 @@ class SparseGRPOTrainer(RLTrainer):
                 if not saved_this_step:
                     self._sparse_save(metrics)
                 self.ckpt.wait()
+                self.tracer.dump_blackbox(
+                    self._telemetry_dir, self.state["global_step"],
+                    "preemption",
+                )
+                self._write_trace()
                 raise Preempted(
                     f"SIGTERM at step {self.state['global_step']}: emergency "
                     f"checkpoint committed to {cfg.output_dir}"
                 )
         # train() returning implies checkpoints are durable (async saver)
         self.ckpt.wait()
+        # balance any open XLA profile window + rewrite trace.json (same
+        # end-of-train contract as the dense loop)
+        self.profile_window.stop()
+        self._write_trace()
         if cfg.export_hf_dir and num_updates is None:
             # handoff artifact (same contract as the dense runtime)
             print(f"exporting HF checkpoint to {cfg.export_hf_dir}")

@@ -27,6 +27,7 @@ TPU execution model (the design inversions of SURVEY.md §7):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Optional
 
@@ -72,6 +73,9 @@ from nanorlhf_tpu.ops.masking import (
 from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
                                         shard_params)
 from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.telemetry import (SpanTracer, flops_param_count,
+                                    peak_flops_per_chip, recompile_counter,
+                                    update_flops)
 from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
 from nanorlhf_tpu.trainer.config import AlgoName, RLConfig
 from nanorlhf_tpu.trainer.metrics import (MetricsLogger,
@@ -221,7 +225,9 @@ class RolloutStream:
         ro = self._body(queries, key)
         # hand the watcher a FROZEN view of the async outputs — blocking on
         # `ro` itself would race the "_index" insertion below
-        note_ready_async(self.meter, (ro["gen_out"], ro.get("greedy")), t0)
+        note_ready_async(self.meter, (ro["gen_out"], ro.get("greedy")), t0,
+                         tracer=getattr(t, "tracer", None),
+                         span_args={"rollout_index": self._idx})
         ro["_index"] = self._idx
         self._idx += 1
         return ro
@@ -489,17 +495,51 @@ class RLTrainer:
             PreemptionGuard() if config.graceful_preemption else null_guard()
         )
 
+        # ---- telemetry (telemetry/, docs/OBSERVABILITY.md) ---------------
+        # Span tracer + flight recorder: off by default — disabled, every
+        # recording call is a cheap no-op, so the instrumentation stays
+        # inline unconditionally (bench's telemetry A/B is the overhead
+        # gate). The MFU/throughput accounting below is plain arithmetic
+        # and is emitted regardless of the flag.
+        self.tracer = SpanTracer(
+            enabled=config.telemetry,
+            max_events=config.telemetry_max_events,
+            ring_len=config.flight_recorder_len,
+        )
+        self._telemetry_dir = config.telemetry_dir or config.output_dir
+        # analytic model-FLOPs inputs (telemetry/mfu.py — the same napkin
+        # model bench.py uses, so the two MFU series cannot drift)
+        self._flops_params = flops_param_count(self.params)
+        self._peak_flops, self._peak_flops_known = peak_flops_per_chip(
+            jax.devices()[0].device_kind, jax.default_backend()
+        )
+        self._n_devices = len(jax.devices())
+        # process-global jax.monitoring backend-compile listener: silent
+        # retraces surface as a perf/recompiles step, not a mystery stall
+        self._recompiles = recompile_counter()
+
         self.ckpt = CheckpointManager(
             config.output_dir, config.save_total_limit,
             config.greater_is_better,
             io_retries=config.ckpt_io_retries,
             retry_backoff=config.ckpt_retry_backoff,
             faults=self.faults,
+            tracer=self.tracer,
         )
         self.logger = MetricsLogger(config.output_dir, config.report_to)
-        from nanorlhf_tpu.utils.profiling import PhaseTimer
+        from nanorlhf_tpu.utils.profiling import PhaseTimer, ProfileWindow
 
-        self.timer = PhaseTimer()
+        self.timer = PhaseTimer(tracer=self.tracer)
+        # windowed XLA profiling (docs/OBSERVABILITY.md): polled at the top
+        # of every update; opens at cfg.profile_at_step or when the trigger
+        # file is touched on a live run
+        self.profile_window = ProfileWindow(
+            config.profile_dir or os.path.join(config.output_dir, "profile"),
+            at_step=config.profile_at_step,
+            num_steps=config.profile_num_steps,
+            trigger_file=config.profile_trigger_file
+            or os.path.join(config.output_dir, "PROFILE"),
+        )
         self._update_fn = self._make_update_fn()
         # int8 rollout weights (core/quant.py): quantize the frozen base
         # projections once under LoRA; full-FT re-quantizes at each dispatch
@@ -631,6 +671,7 @@ class RLTrainer:
                 restore=self._orch_restore_state,
                 heartbeat=self.cfg.producer_heartbeat,
                 faults=self.faults,
+                tracer=self.tracer,
             )
             self._orch_restore_state = None
         return self._orchestrator
@@ -664,6 +705,47 @@ class RLTrainer:
         """Cumulative rollout/train overlap fraction (orchestrator metric;
         also measured for serial / rollout_ahead runs) — bench reads this."""
         return self._rollout_meter.overlap_fraction()
+
+    # ------------------------------------------------------------------ #
+    # telemetry: perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md)
+    # ------------------------------------------------------------------ #
+
+    def _perf_metrics(self, *, step_wall_s: float, decode_tokens: float,
+                      prefill_tokens: float, score_tokens: float,
+                      train_tokens: float, rollout_s: float,
+                      update_s: float) -> dict:
+        """Per-update throughput/MFU rows (docs/METRICS.md `perf/*`): the
+        analytic napkin FLOPs model from telemetry/mfu.py (shared with
+        bench.py — one formula, two consumers). Token counts come from the
+        caller's actual per-phase work; the dense and sparse loops both
+        feed this, so the two runtimes report comparable series.
+
+        `perf/tokens_per_sec_rollout` divides by the trainer-OBSERVED
+        rollout phase seconds: under the orchestrator that window is just
+        the fetch wait, so the metric reads as effective pipeline
+        throughput (it rises as overlap hides generation), not raw
+        generation speed — the producer's own speed is visible in the
+        trace spans."""
+        flops = update_flops(
+            self._flops_params,
+            decode_tokens=decode_tokens, prefill_tokens=prefill_tokens,
+            score_tokens=score_tokens, train_tokens=train_tokens,
+        )
+        all_tokens = decode_tokens + prefill_tokens + score_tokens + train_tokens
+        return {
+            "perf/mfu": flops / max(step_wall_s, 1e-9)
+            / (self._peak_flops * self._n_devices),
+            "perf/tokens_per_sec_step": all_tokens / max(step_wall_s, 1e-9),
+            "perf/tokens_per_sec_update": train_tokens / max(update_s, 1e-9),
+            "perf/tokens_per_sec_rollout": (decode_tokens + prefill_tokens)
+            / max(rollout_s, 1e-9),
+            "perf/model_flops_per_step": flops,
+            # cumulative real backend compiles (jax.monitoring): a step
+            # where this increments mid-run is a silent retrace
+            "perf/recompiles": float(self._recompiles.count),
+            "perf/recompile_seconds": self._recompiles.seconds,
+            "telemetry/spans_dropped": float(self.tracer.dropped),
+        }
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -1253,6 +1335,14 @@ class RLTrainer:
                     try:
                         sample = orch.get()
                     except ProducerFailed as e:
+                        # flight recorder first: the blackbox must capture
+                        # what every thread was doing when the producer
+                        # died, before the restart machinery mutates state
+                        self.tracer.dump_blackbox(
+                            self._telemetry_dir, self.state["global_step"],
+                            "producer_failure",
+                            extra={"error": repr(e.__cause__ or e)},
+                        )
                         decision, delay = self.watchdog.on_failure()
                         if decision == ProducerWatchdog.RESTART:
                             cause = e.__cause__ or e
@@ -1304,6 +1394,15 @@ class RLTrainer:
         target_step = self.state["global_step"] + n_updates
         while self.state["global_step"] < target_step:
             t_start = time.time()
+            step_t0 = time.perf_counter()
+            # windowed XLA profiling: open/close the jax.profiler window
+            # for the update about to run (cfg.profile_at_step or the
+            # on-demand trigger file)
+            self.profile_window.poll(self.state["global_step"] + 1)
+            # per-update trace span: recorded via add_complete at the end
+            # of the iteration (a with-block could not survive the sentinel
+            # rollback's `continue`)
+            span_t0 = self.tracer.now_us() if self.tracer.enabled else 0.0
 
             # ---- ROLLOUT -------------------------------------------------
             with self.timer.phase("rollout"):
@@ -1522,7 +1621,27 @@ class RLTrainer:
                 agg.get("pg_loss", 0.0), agg.get("grad_norm")
             )
             if verdict is not None:
+                if self.tracer.enabled:
+                    # close the tripped update's span BEFORE the rollback
+                    # dumps the flight recorder, so the blackbox ring holds
+                    # it — tagged with the quarantined rollout index
+                    self.tracer.add_complete(
+                        "train.update", span_t0,
+                        self.tracer.now_us() - span_t0,
+                        step=self.state["global_step"] + 1,
+                        rollout_index=rollout_index,
+                        staleness=sample_staleness,
+                        policy_version=(orch.version if use_orch
+                                        else self.state["global_step"]),
+                        sentinel_verdict=verdict, quarantined=True,
+                    )
                 self._sentinel_rollback(verdict, rollout_index)
+                # discard the tripped update's phase splits: the continue
+                # skips this iteration's summary() reset, and the replayed
+                # update's time/*_s rows — and the perf/tokens_per_sec_*
+                # divisors that read timer.totals — would otherwise fold in
+                # two updates' worth of wall time
+                self.timer.summary()
                 # the rollback tore the pipeline down and rewound the
                 # data/PRNG cursors — rebuild handles and replay
                 stream = None
@@ -1607,6 +1726,12 @@ class RLTrainer:
                     "orchestrator/queue_depth": float(queue_depth),
                     "orchestrator/staleness": float(sample_staleness),
                     "orchestrator/dropped_total": float(ostats["dropped"]),
+                    # who-waits-on-whom (cumulative s): trainer starved vs
+                    # producer gated — which side is the bottleneck
+                    "orchestrator/consumer_wait_s": ostats["consumer_wait_s"],
+                    "orchestrator/producer_gate_wait_s": ostats[
+                        "producer_gate_wait_s"
+                    ],
                 })
                 metrics.update(staleness_histogram_metrics(
                     ostats["staleness_counts"]
@@ -1651,6 +1776,28 @@ class RLTrainer:
                     if cfg.fused_logprob and not self._sp_on() else 0.0
                 ),
             })
+            # ---- perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md):
+            # token counts from THIS update's actual work — decode at the
+            # configured response_length (the napkin model's convention),
+            # scoring forwards as actually run (0 in ref-free+capture, 1
+            # with capture or ref-free, 2 otherwise)
+            n_rollout_rows = batch_size * n
+            t_resp = batch["responses"].shape[1]
+            score_forwards = (
+                0 if (ref_free and score_capture)
+                else 1 if (ref_free or score_capture) else 2
+            )
+            metrics.update(self._perf_metrics(
+                step_wall_s=time.perf_counter() - step_t0,
+                decode_tokens=n_rollout_rows * cfg.response_length,
+                prefill_tokens=n_rollout_rows * context_length,
+                score_tokens=score_forwards * total
+                * (context_length + cfg.response_length),
+                train_tokens=cfg.num_ppo_epochs * local_bs
+                * (context_length + t_resp),
+                rollout_s=self.timer.totals.get("rollout", 0.0),
+                update_s=self.timer.totals.get("update", 0.0),
+            ))
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
             if self.state["global_step"] % cfg.logging_steps == 0:
@@ -1668,6 +1815,18 @@ class RLTrainer:
             # overlap meter: consumer busy window = everything since the
             # sample was fetched (reward, scoring, update, logging, save)
             meter.note_busy(t_busy0, time.time())
+            if self.tracer.enabled:
+                # the completed update's span on the trainer thread's track,
+                # with the correlation args that make trace.json queryable
+                self.tracer.add_complete(
+                    "train.update", span_t0, self.tracer.now_us() - span_t0,
+                    step=self.state["global_step"],
+                    rollout_index=rollout_index,
+                    staleness=sample_staleness,
+                    policy_version=(orch.version if use_orch
+                                    else self.state["global_step"]),
+                )
+                self.tracer.counter("staleness", sample_staleness)
 
             # ---- PREEMPTION (SIGTERM, docs/RESILIENCE.md) ------------------
             # polled at the update boundary where state is consistent: flush
@@ -1677,6 +1836,13 @@ class RLTrainer:
                 if not saved_this_step:
                     self._save_checkpoint(orch if use_orch else None, metrics)
                 self.ckpt.wait()
+                # blackbox + trace alongside the emergency checkpoint: the
+                # post-mortem gets "what was every thread doing at SIGTERM"
+                self.tracer.dump_blackbox(
+                    self._telemetry_dir, self.state["global_step"],
+                    "preemption",
+                )
+                self._write_trace()
                 raise Preempted(
                     f"SIGTERM at step {self.state['global_step']}: emergency "
                     f"checkpoint committed to {self.cfg.output_dir}"
@@ -1686,6 +1852,11 @@ class RLTrainer:
         # in-flight async save (saves mid-run overlap training; only this
         # final one blocks)
         self.ckpt.wait()
+        # balance any still-open XLA profile window, and rewrite trace.json
+        # after EVERY train() call (bench's train(num_updates=1) pattern
+        # would otherwise only get a trace at close())
+        self.profile_window.stop()
+        self._write_trace()
         # load_best_model_at_end parity (`GRPO/grpo.py:149`, resolved via the
         # `_old` one-save-back metric semantics, `grpo_trainer.py:374-382`)
         if cfg.load_best_model_at_end and num_updates is None:
@@ -1701,6 +1872,17 @@ class RLTrainer:
             print(f"exporting HF checkpoint to {cfg.export_hf_dir}")
             self.export_model(cfg.export_hf_dir)
         return self.state
+
+    def _write_trace(self):
+        """Rewrite `<telemetry_dir>/trace.json` from the full buffered span
+        history (no-op when telemetry is off). Load it at
+        https://ui.perfetto.dev or chrome://tracing."""
+        path = self.tracer.write_trace(
+            os.path.join(self._telemetry_dir, "trace.json")
+        )
+        if path is not None:
+            print(f"[telemetry] trace written: {path}")
+        return path
 
     def _restore_template(self):
         """Mirror of what checkpoint.save() writes — single source of truth
@@ -1763,9 +1945,16 @@ class RLTrainer:
                 dtype=np.float32,
             )
 
-        return retry_with_backoff(
-            attempt, attempts=self.cfg.reward_retries + 1, backoff_base=0.1
-        )
+        # a dedicated "reward" trace track: the host-side graders
+        # (subprocess sympy, RM inference) are a classic hidden step-time
+        # eater the device-phase split cannot attribute. span() is a no-op
+        # when telemetry is off — one call site either way.
+        with self.tracer.span("reward.dispatch", track="reward",
+                              rows=len(prompts_and_responses)):
+            return retry_with_backoff(
+                attempt, attempts=self.cfg.reward_retries + 1,
+                backoff_base=0.1,
+            )
 
     def _sentinel_rollback(self, verdict: str, rollout_index: int):
         """Sentinel trip (docs/RESILIENCE.md): charge the rollback budget,
@@ -1779,6 +1968,20 @@ class RLTrainer:
             f"[resilience] sentinel tripped ({verdict}) at step "
             f"{step_attempted} (rollout {rollout_index}) — rolling back to "
             f"checkpoint {last}"
+        )
+        # flight recorder FIRST (before note_rollback can raise on budget
+        # exhaustion and before the restore rewinds state): the blackbox
+        # holds the tripped step's span (tagged with the quarantined
+        # rollout index), every thread's in-flight spans, and the latest
+        # counter snapshots — alongside the checkpoint it rolls back to
+        self.tracer.instant(
+            "sentinel.trip", verdict=verdict, rollout_index=rollout_index,
+            step=step_attempted,
+        )
+        self.tracer.dump_blackbox(
+            self._telemetry_dir, step_attempted, "sentinel_trip",
+            extra={"verdict": verdict, "rollout_index": int(rollout_index),
+                   "rollback_to_step": last},
         )
         if last is None:
             raise RuntimeError(
@@ -1890,6 +2093,11 @@ class RLTrainer:
         if self._orchestrator is not None:
             self._orchestrator.close()  # stop + join the producer thread
             self._orchestrator = None
+        # balance an XLA profile window an exception may have left open
+        # (otherwise every later start_trace in the process fails), and
+        # write the trace a crashed train() never reached
+        self.profile_window.stop()
+        self._write_trace()
         self.ckpt.close()  # flush any in-flight async checkpoint write
         self.logger.close()
         self._preemption.uninstall()  # restore the previous SIGTERM handler

@@ -1,4 +1,4 @@
-"""Tracing / profiling utilities (SURVEY.md §5.1).
+"""Tracing / profiling utilities (SURVEY.md §5.1, docs/OBSERVABILITY.md).
 
 The reference's observability is ad-hoc: an unused memory_profiler import, a
 commented-out CUDA memory recorder, and one wall-clock print per update
@@ -7,39 +7,63 @@ equivalents:
 
 - `PhaseTimer`: per-phase wall-clock split (rollout / reward / logprob /
   update) the reference only has implicitly — `block_until_ready` at phase
-  end so device async dispatch doesn't lie about where the time goes;
+  end so device async dispatch doesn't lie about where the time goes. Timing
+  uses `time.perf_counter()` (monotonic): wall-clock `time.time()` jumps
+  under NTP steps, which corrupts phase splits and everything downstream of
+  them (the cumulative MFU accounting integrates these numbers over a run).
+  With a telemetry.SpanTracer attached, every phase is also recorded as a
+  trace span on the calling thread's track.
 - `trace_profile`: a `jax.profiler` trace context writing a TensorBoard-
-  loadable profile (XLA op breakdown, HBM usage) to a directory.
+  loadable profile (XLA op breakdown, HBM usage) to a directory; start/stop
+  stay balanced on exception, so a failed step doesn't wedge the profiler
+  for the rest of the process.
+- `ProfileWindow`: cfg-driven windowed profiling — the trainer polls it each
+  update, and it wraps `trace_profile` around exactly the configured steps
+  (`profile_at_step`/`profile_num_steps`) or around a window requested
+  on-demand by touching a trigger file. Whole-run always-on profiling is
+  useless at scale (GBs of XLA trace per minute); a 1–2 step window at a
+  chosen step is what actually gets loaded into TensorBoard.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 import time
+from typing import Optional
 
 import jax
 
 
 class PhaseTimer:
-    """Accumulates wall-clock per named phase; one line per update."""
+    """Accumulates monotonic wall-clock per named phase; one line per update."""
 
-    def __init__(self):
+    def __init__(self, tracer=None, span_prefix: str = "trainer."):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
         # never reset: whole-run phase split (bench MFU accounting reads this
         # across updates while the per-update summary() resets each step)
         self.cumulative: dict[str, float] = {}
+        # optional telemetry.SpanTracer: phases double as trace spans
+        self.tracer = tracer
+        self.span_prefix = span_prefix
 
     @contextlib.contextmanager
     def phase(self, name: str):
         """Callers must block on the phase's outputs inside the block (e.g.
         `jax.block_until_ready(...)`) or async dispatch shifts time into the
         next phase."""
-        t0 = time.time()
+        span = (
+            self.tracer.span(self.span_prefix + name)
+            if self.tracer is not None and self.tracer.enabled
+            else contextlib.nullcontext()
+        )
+        t0 = time.perf_counter()
         try:
-            yield
+            with span:
+                yield
         finally:
-            dt = time.time() - t0
+            dt = time.perf_counter() - t0
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
             self.cumulative[name] = self.cumulative.get(name, 0.0) + dt
@@ -53,12 +77,83 @@ class PhaseTimer:
 
 @contextlib.contextmanager
 def trace_profile(log_dir: str, enabled: bool = True):
-    """jax.profiler trace scope: `with trace_profile('/tmp/prof'): step()`."""
+    """jax.profiler trace scope: `with trace_profile('/tmp/prof'): step()`.
+
+    The finally-stop keeps start/stop BALANCED when the profiled body
+    raises — without it the process-global profiler stays active and every
+    later start_trace in the process fails with "already started"."""
     if not enabled:
         yield
         return
+    os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class ProfileWindow:
+    """Windowed XLA profiling around exactly N configured updates.
+
+    `poll(step)` is called at the TOP of each update with the 1-based step
+    about to run: the window opens when `step == at_step` (or when the
+    trigger file appears — `touch <output_dir>/PROFILE` on a live run) and
+    closes after `num_steps` updates. `stop()` is idempotent and must be
+    reachable from the trainer's close() path so an exception inside a
+    profiled step still balances start/stop."""
+
+    def __init__(self, log_dir: str, at_step: Optional[int] = None,
+                 num_steps: int = 1, trigger_file: Optional[str] = None):
+        self.log_dir = log_dir
+        self.at_step = at_step
+        self.num_steps = max(1, int(num_steps))
+        self.trigger_file = trigger_file
+        self.windows = 0          # completed windows (test/debug introspection)
+        self._cm = None
+        self._stop_at: Optional[int] = None
+        self._armed = at_step is not None
+
+    @property
+    def active(self) -> bool:
+        return self._cm is not None
+
+    def _trigger_requested(self) -> bool:
+        if not self.trigger_file or not os.path.exists(self.trigger_file):
+            return False
+        try:
+            os.remove(self.trigger_file)  # consume the request
+        except OSError:
+            pass
+        return True
+
+    def poll(self, step: int) -> None:
+        """Advance the window state machine for the update about to run."""
+        if self.active and step >= self._stop_at:
+            self.stop()
+        if self.active:
+            return
+        start = self._armed and self.at_step is not None and step >= self.at_step
+        if start:
+            self._armed = False  # one cfg-driven window per run
+        if start or self._trigger_requested():
+            self._start(step)
+
+    def _start(self, step: int) -> None:
+        self._cm = trace_profile(self.log_dir)
+        self._cm.__enter__()
+        self._stop_at = step + self.num_steps
+        print(f"[profile] XLA trace window open: steps {step}.."
+              f"{self._stop_at - 1} -> {self.log_dir}")
+
+    def stop(self) -> None:
+        """Close an open window (idempotent; called from poll, the end of
+        train(), and trainer.close())."""
+        if self._cm is None:
+            return
+        cm, self._cm = self._cm, None
+        self._stop_at = None
+        try:
+            cm.__exit__(None, None, None)
+        finally:
+            self.windows += 1
