@@ -29,6 +29,10 @@ BENCH_QUANT (0 | 1: int8 rollout weights), BENCH_AHEAD (0 | 1: overlap),
 BENCH_ORCH (0 | 1: async rollout orchestrator, docs/ORCHESTRATOR.md),
 BENCH_STALENESS (2: orchestrator max_staleness),
 BENCH_KV_QUANT (0 | 1: int8 KV cache),
+BENCH_SPEC_K (0: speculative rollout decode draft length, cfg.rollout_spec_k
+— the n-gram draft + batched-verify lever, sampler/speculative.py; the
+always-run detail.spec_decode A/B additionally reports its acceptance /
+dispatch-count win on a repetitive synthetic corpus, TPU or CPU alike),
 BENCH_SENTINEL (1: also measure the training sentinel disabled and report
 detail.sentinel.sentinel_overhead_frac — the resilience guard's cost on
 the step wall, docs/RESILIENCE.md),
@@ -386,6 +390,83 @@ def _decode_on_chip_check(jax) -> dict:
     return result
 
 
+def _spec_decode_check(jax) -> dict:
+    """Speculative-decode lever A/B on a REPETITIVE synthetic corpus — the
+    deterministic Markov "cycle model" (layers zeroed, untied one-hot head:
+    token t always yields sigma(t)) emits a period-4 stream, the n-gram
+    drafter's best case. Reports acceptance rate, tokens emitted per verify
+    dispatch, and the dispatch-count ratio vs the monolithic loop (which
+    pays one dispatch per token) — the ISSUE-5 acceptance gate is >= 2x
+    fewer dispatches at spec_k=4. Runs on every backend (tiny model), so
+    the CPU-fallback bench carries the row while the TPU tunnel is down.
+    spec_k=0 routes through the untouched monolithic jit (zero cost when
+    the lever is off); its wall is reported for reference."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+
+    V, rows, resp, spec_k = 32, 8, 128, 4
+    mcfg = dataclasses.replace(
+        ModelConfig.qwen2_tiny(vocab_size=V), tie_word_embeddings=False
+    )
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    sigma[[5, 6, 7, 8]] = [6, 7, 8, 5]                  # 4-cycle, no EOS
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    ids = jnp.asarray(np.tile([5, 6, 7, 8, 5], (rows, 1)), jnp.int32)
+    mask = jnp.ones_like(ids, bool)
+    kw = dict(eos_token_id=3, pad_token_id=0)
+
+    def wall(sp, stats_out=None):
+        ts = []
+        for rep in range(2):                            # compile + 1 timed
+            t0 = time.time()
+            out = generate(params, mcfg, ids, mask, jax.random.PRNGKey(rep),
+                           sp, spec_stats_out=stats_out, **kw)
+            np.asarray(out)
+            ts.append(time.time() - t0)
+        return out, ts[-1]
+
+    out0, sec0 = wall(SamplingParams(greedy=True, max_tokens=resp))
+    stats: list = []
+    out1, sec1 = wall(
+        SamplingParams(greedy=True, max_tokens=resp, spec_k=spec_k),
+        stats_out=stats,
+    )
+    st = {k: int(np.asarray(v)) for k, v in stats[-1].items()}
+    mono_steps = resp - 1                               # one dispatch/token after prefill
+    identical = bool(np.array_equal(np.asarray(out0), np.asarray(out1)))
+    return {
+        "spec_k": spec_k,
+        "response_length": resp,
+        "acceptance_rate": round(st["accepted"] / max(st["drafted"], 1), 4),
+        "accepted_per_step": round(st["emitted"] / max(st["row_steps"], 1), 3),
+        "dispatch_steps_spec": st["verify_steps"],
+        "dispatch_steps_monolithic": mono_steps,
+        "dispatch_ratio": round(mono_steps / max(st["verify_steps"], 1), 2),
+        "greedy_bit_identical": identical,
+        "sec_spec": round(sec1, 3),
+        "sec_spec_off": round(sec0, 3),
+        "spec_check": "ok" if (
+            identical and st["verify_steps"] * 2 <= mono_steps
+        ) else "MISMATCH",
+    }
+
+
 def _flash_on_chip_check(jax) -> dict:
     import jax.numpy as jnp
 
@@ -486,6 +567,7 @@ def run_bench(jax, init_error):
     orchestrator = os.environ.get("BENCH_ORCH", "0") == "1"
     orch_staleness = int(os.environ.get("BENCH_STALENESS", "2"))
     kv_cache_quant = "int8" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "none"
+    spec_k_env = int(os.environ.get("BENCH_SPEC_K", "0"))
     # BENCH_SWEEP=1 (default on real TPU): after the baseline, ALSO measure
     # the int8 rollout levers and report the faster config as the headline.
     # A lever failure (lowering, numerics) falls back to the already-measured
@@ -532,7 +614,7 @@ def run_bench(jax, init_error):
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
-                telemetry=False):
+                telemetry=False, spec_k=None):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -546,6 +628,7 @@ def run_bench(jax, init_error):
         rollout_train_overlap_frac rows make that visible.
         """
         resp = response_len if resp is None else resp
+        spec_k = spec_k_env if spec_k is None else spec_k
         cfg = RLConfig(
             algo=AlgoName.GRPO,
             output_dir="/tmp/nanorlhf_tpu_bench",
@@ -566,6 +649,7 @@ def run_bench(jax, init_error):
             sentinel=sentinel,
             telemetry=telemetry,
             kv_cache_quant=kv_quant,
+            rollout_spec_k=spec_k,
             gradient_checkpointing=True,
             mesh=MeshConfig(n_dev, 1, 1),
             save_steps=0,
@@ -596,6 +680,7 @@ def run_bench(jax, init_error):
             "rollout_orchestrator": orchestrator,
             "max_staleness": staleness if orchestrator else None,
             "rollout_shared_prefill": cfg.rollout_shared_prefill,
+            "rollout_spec_k": spec_k,
             "sampler_logprob_capture": cfg.sampler_logprob_capture,
             "response_length": resp,
             "sec_per_update_steady": round(sec, 3),
@@ -693,6 +778,33 @@ def run_bench(jax, init_error):
                 sweep_detail["orchestrator_error"] = (
                     f"{type(e).__name__}: {e}"[:300]
                 )
+        # speculative-decode lever (sampler/speculative.py): draft-free
+        # n-gram drafting + batched k-token verify at spec_k=4. Its win is
+        # corpus-dependent (acceptance on the toy-tokenizer corpus is the
+        # pessimistic floor; R1 math rollouts are the target) — the
+        # detail.spec_decode synthetic A/B carries the mechanism's ceiling,
+        # this sweep point carries the end-to-end wall on the bench corpus.
+        if (spec_k_env == 0 and isinstance(sweep_detail, dict)
+                and budget - (time.time() - _T0) > 1.2 * t_baseline):
+            try:
+                spec = measure(
+                    chosen["rollout_quant"], chosen["kv_cache_quant"],
+                    chosen["rollout_ahead"],
+                    capture=chosen["sampler_logprob_capture"],
+                    orchestrator=chosen["rollout_orchestrator"],
+                    staleness=chosen["max_staleness"] or orch_staleness,
+                    spec_k=4,
+                )
+                sweep_detail["spec_k4_sec_per_update"] = (
+                    spec["sec_per_update_steady"]
+                )
+                if (spec["sec_per_update_steady"]
+                        < chosen["sec_per_update_steady"]):
+                    chosen = spec
+            except Exception as e:
+                sweep_detail["spec_k4_error"] = (
+                    f"{type(e).__name__}: {e}"[:300]
+                )
 
     # sentinel-overhead point (docs/RESILIENCE.md acceptance: the guard
     # costs <2% of the step wall): re-measure the chosen config with the
@@ -711,6 +823,7 @@ def run_bench(jax, init_error):
                 capture=chosen["sampler_logprob_capture"],
                 orchestrator=chosen["rollout_orchestrator"],
                 staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
                 sentinel=False,
             )
             off_sec = guard_off["sec_per_update_steady"]
@@ -740,6 +853,7 @@ def run_bench(jax, init_error):
                 capture=chosen["sampler_logprob_capture"],
                 orchestrator=chosen["rollout_orchestrator"],
                 staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
                 telemetry=True,
             )
             on_sec = tele_on["sec_per_update_steady"]
@@ -777,6 +891,7 @@ def run_bench(jax, init_error):
                 capture=chosen["sampler_logprob_capture"],
                 orchestrator=chosen["rollout_orchestrator"],
                 staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
             )
             short_detail = {
                 "response_length": 256,
@@ -825,6 +940,12 @@ def run_bench(jax, init_error):
     )
 
     pallas = pallas_on_chip_check(jax)
+    try:
+        # always-run A/B (tiny model, any backend): the lever's acceptance/
+        # dispatch mechanics stay measurable on the CPU-fallback bench
+        spec_decode_detail = _spec_decode_check(jax)
+    except Exception as e:
+        spec_decode_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     detail = {
         "backend": backend,
@@ -841,8 +962,10 @@ def run_bench(jax, init_error):
         "max_staleness": chosen["max_staleness"],
         "rollout_train_overlap_frac": chosen["rollout_train_overlap_frac"],
         "rollout_shared_prefill": chosen["rollout_shared_prefill"],
+        "rollout_spec_k": chosen.get("rollout_spec_k", 0),
         "sampler_logprob_capture": chosen["sampler_logprob_capture"],
         "kv_cache_quant": kv_cache_quant,
+        "spec_decode": spec_decode_detail,
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

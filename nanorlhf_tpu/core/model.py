@@ -253,9 +253,31 @@ def _proj(h, layer_params, lora_layer, name, lora_scale):
     return y
 
 
+def _cache_update(cache, new, idx):
+    """Write `new` [B, KV, T, hd] into `cache` [B, KV, T_max, hd] at slot
+    `idx` along the sequence axis. A scalar `idx` is the shared-slot decode/
+    prefill path; a per-row [B] `idx` (speculative verify — accepted rows
+    advance at different rates) vmaps the update over the batch."""
+    if getattr(idx, "ndim", 0) == 1:
+        return jax.vmap(
+            lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (0, i, 0))
+        )(cache, new, idx)
+    return jax.lax.dynamic_update_slice(cache, new, (0, 0, idx, 0))
+
+
+def _scale_update(cache, new, idx):
+    """Same for the int8 cache's sublane-expanded scales [B, KV, 8, T_max]
+    (sequence on the LAST axis)."""
+    if getattr(idx, "ndim", 0) == 1:
+        return jax.vmap(
+            lambda c, n, i: jax.lax.dynamic_update_slice(c, n, (0, 0, i))
+        )(cache, new, idx)
+    return jax.lax.dynamic_update_slice(cache, new, (0, 0, 0, idx))
+
+
 def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
                 cache_index, lora_layer=None, lora_scale=1.0, attn_fn=None,
-                decode_bounds=None):
+                decode_bounds=None, verify_bounds=None):
     """One decoder layer. If kv_cache is not None, operate incrementally.
 
     Returns (x_out, new_kv_pair_or_None).
@@ -263,6 +285,12 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
     `attn_fn(q, k, v)`, when given, replaces the attention contraction (used
     by the sequence-parallel path to route through ring attention) — every
     other op stays this single implementation.
+    `verify_bounds=(start, fill)` ([B] each) marks the speculative-verify
+    path: T = k+1 candidate tokens per row, cache_index is per-row, and
+    attention runs the k-query prefix-bounded contraction over the cache
+    (general masked XLA attention off-TPU / for the int8 cache, which
+    dequantizes — correct, no bandwidth win; the single-token q8 kernel is
+    unaffected).
     """
     hd = config.actual_head_dim
     H, KV = config.num_attention_heads, config.num_key_value_heads
@@ -288,12 +316,21 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         kq_c, ks_c, vq_c, vs_c = kv_cache
         k_q, k_s = _quantize_kv(k)
         v_q, v_s = _quantize_kv(v)
-        kq_c = jax.lax.dynamic_update_slice(kq_c, k_q, (0, 0, cache_index, 0))
-        vq_c = jax.lax.dynamic_update_slice(vq_c, v_q, (0, 0, cache_index, 0))
-        ks_c = jax.lax.dynamic_update_slice(ks_c, k_s, (0, 0, 0, cache_index))
-        vs_c = jax.lax.dynamic_update_slice(vs_c, v_s, (0, 0, 0, cache_index))
+        kq_c = _cache_update(kq_c, k_q, cache_index)
+        vq_c = _cache_update(vq_c, v_q, cache_index)
+        ks_c = _scale_update(ks_c, k_s, cache_index)
+        vs_c = _scale_update(vs_c, v_s, cache_index)
         new_cache = (kq_c, ks_c, vq_c, vs_c)
-        if T > 1 and use_flash(config.attention_impl, T):
+        if verify_bounds is not None:
+            # speculative verify over the int8 cache: dequantize and run the
+            # general masked path — correct everywhere, no bandwidth win
+            # (the q8 k-query kernel is future work; single-token decode
+            # keeps the q8 kernel either way)
+            out = gqa_attention(
+                q, _dequantize_kv(kq_c, ks_c, q.dtype),
+                _dequantize_kv(vq_c, vs_c, q.dtype), mask,
+            )
+        elif T > 1 and use_flash(config.attention_impl, T):
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
                                 mask_is_causal_x_keyvalid=True, spmd=spmd)
         elif T > 1:
@@ -322,10 +359,30 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             )
     elif kv_cache is not None:
         k_cache, v_cache = kv_cache
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, cache_index, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, cache_index, 0))
+        k_cache = _cache_update(k_cache, k, cache_index)
+        v_cache = _cache_update(v_cache, v, cache_index)
         new_cache = (k_cache, v_cache)
-        if T > 1 and use_flash(config.attention_impl, T):
+        if verify_bounds is not None:
+            # speculative verify: T = k+1 candidate queries read the cache
+            # (their KV just landed at per-row slots [fill, fill+T)). The
+            # k-query prefix-bounded kernel on TPU; the general masked XLA
+            # contraction elsewhere (mask carries prefix + causal-within-
+            # candidates, built by decode_verify).
+            if use_decode_kernel(config.attention_impl, k_cache.shape[2]):
+                from nanorlhf_tpu.ops.decode_attention import (
+                    decode_verify_attention,
+                )
+
+                start, vfill = verify_bounds
+                ver_args = (q, k_cache, v_cache, start, vfill)
+                if spmd is not None:
+                    out = _spmd_call(spmd, decode_verify_attention, ver_args,
+                                     (1, 1, 1, None, None))
+                else:
+                    out = decode_verify_attention(*ver_args)
+            else:
+                out = gqa_attention(q, k_cache, v_cache, mask)
+        elif T > 1 and use_flash(config.attention_impl, T):
             # prefill: cache slots beyond T are masked anyway, so attend over
             # the local-length K/V through the flash kernel instead of the
             # T_max-padded cache
@@ -366,7 +423,7 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
 
 def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
                 lora_scale=1.0, remat=False, attn_fn=None, layer_transform=None,
-                decode_bounds=None):
+                decode_bounds=None, verify_bounds=None):
     """Scan the stacked layer params over the layer body.
 
     `remat=True` wraps the body in jax.checkpoint — the training path's
@@ -415,7 +472,7 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
             y, new_cache = _layer_body(
                 config, carry, layer_params, cos, sin, mask, tuple(inp[2:]),
                 cache_index, lora_layer, lora_scale,
-                decode_bounds=decode_bounds,
+                decode_bounds=decode_bounds, verify_bounds=verify_bounds,
             )
             return y, new_cache
 
@@ -726,3 +783,45 @@ def decode_step(
     )
     logits = _logits(config, params, x)[:, 0, :]
     return logits, new_caches
+
+
+def decode_verify(
+    params: dict,
+    config: ModelConfig,
+    tokens: jnp.ndarray,          # [B, Tq] candidates: last accepted + k drafts
+    positions: jnp.ndarray,       # [B, Tq] their absolute position ids
+    fill: jnp.ndarray,            # [B] cache slot of tokens[:, 0] (per-row!)
+    key_mask: jnp.ndarray,        # [B, T_max] valid slots BEFORE this call
+                                  # (excludes the candidate slots)
+    kv_caches: tuple[jnp.ndarray, ...],
+    lora_scale: float = 1.0,
+):
+    """Batched k-token verification for speculative decode
+    (sampler/speculative.py): one small-T causal forward over Tq = k+1
+    candidate tokens against the cache — the prefill attention recipe at
+    decode granularity, so the dominant per-step weight stream is amortized
+    over every candidate. Candidate KV is written at per-row slots
+    [fill, fill+Tq) (accepted rows advance at different rates, hence the
+    [B]-shaped slot index); query i attends to `key_mask` plus candidates
+    0..i. Rejected candidates leave garbage KV in slots the caller never
+    marks valid — the next verify overwrites them. Returns
+    (logits [B, Tq, V], new caches): logits[:, i] is the next-token
+    distribution after consuming candidates 0..i, bit-matching a chain of
+    `decode_step` calls over the same tokens on the CPU mesh (test-pinned).
+    """
+    B, Tq = tokens.shape
+    T_max = kv_caches[0].shape[3]
+    key_mask = key_mask.astype(bool)
+    x = params["embed_tokens"][tokens].astype(params["embed_tokens"].dtype)
+    cos, sin = rope_tables(positions, config.actual_head_dim, config.rope_theta)
+    slot = jnp.arange(T_max)[None, None, :]                  # [1, 1, T_max]
+    qi = jnp.arange(Tq)[None, :, None]                       # [1, Tq, 1]
+    cand = (slot >= fill[:, None, None]) & (slot <= fill[:, None, None] + qi)
+    mask = (key_mask[:, None, :] | cand)[:, None, :, :]      # [B, 1, Tq, T_max]
+    start = jnp.argmax(key_mask, axis=1).astype(jnp.int32)
+    x, new_caches = _run_layers(
+        config, params, x, cos, sin, mask, kv_caches=kv_caches,
+        cache_index=fill.astype(jnp.int32), lora_scale=lora_scale,
+        verify_bounds=(start, fill.astype(jnp.int32)),
+    )
+    return _logits(config, params, x), new_caches

@@ -10,7 +10,8 @@ from nanorlhf_tpu.trainer import AlgoName, RLConfig
 
 def build_config(sequence_parallel: int = 1,
                  rollout_staleness: int | None = None,
-                 rollout_devices: int = 0) -> RLConfig:
+                 rollout_devices: int = 0,
+                 rollout_spec_k: int = 0) -> RLConfig:
     """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
     update through ring attention with the sequence dim sharded over an sp
     mesh axis (response_length must divide by it).
@@ -19,7 +20,11 @@ def build_config(sequence_parallel: int = 1,
     (docs/ORCHESTRATOR.md) at that max_staleness, with sampler logprob
     capture so the truncated-IS off-policy correction has the behavior
     logprobs it needs; pair with `rollout_devices > 0` to give generation
-    its own device group so it truly never waits on the train step."""
+    its own device group so it truly never waits on the train step.
+
+    `rollout_spec_k > 0` turns on draft-free speculative rollout decode
+    (sampler/speculative.py, distribution-exact); composes with every knob
+    above except rollout_compaction_segments."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-v1",
@@ -64,6 +69,7 @@ def build_config(sequence_parallel: int = 1,
         cfg.sampler_logprob_capture = True  # behavior logprobs for the IS fix
     if rollout_devices > 0:
         cfg.rollout_devices = rollout_devices
+    cfg.rollout_spec_k = rollout_spec_k
     return cfg
 
 

@@ -33,13 +33,19 @@ TEMPLATE = (
 
 
 def build_config(sequence_parallel: int = 1,
-                 rollout_ahead: bool = False) -> RLConfig:
+                 rollout_ahead: bool = False,
+                 rollout_spec_k: int = 0) -> RLConfig:
     """`sequence_parallel > 1` shards the 8k-token scoring/update passes over
     an sp mesh axis (ring attention, `parallel/sp.py`) — context beyond one
     chip's HBM. Devices split as (data = n/sp, sp); response_length must be
     a multiple of sp. `rollout_ahead` overlaps the next update's generation
     with this update's sympy grading (one-update-stale rollouts, clip-
-    corrected — trainer/config.py)."""
+    corrected — trainer/config.py). `rollout_spec_k > 0` turns on draft-free
+    speculative rollout decode (sampler/speculative.py) — THIS launcher is
+    its natural home: R1-style math rollouts restate the problem and repeat
+    `\\boxed{}` / step templates, exactly what the n-gram drafter feeds on;
+    sampled rollouts stay distribution-exact. Try 4; watch
+    rollout/draft_acceptance."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-r1-v0",
@@ -77,6 +83,7 @@ def build_config(sequence_parallel: int = 1,
         export_hf_dir="output/grpo-r1-v0/hf_export",
     )
     cfg.rollout_ahead = rollout_ahead
+    cfg.rollout_spec_k = rollout_spec_k
     if sequence_parallel > 1:
         from nanorlhf_tpu.parallel import MeshConfig
 

@@ -254,6 +254,158 @@ def decode_attention_q8(
     return out[:, :, :G, :].reshape(B, H, hd)
 
 
+def reference_decode_verify_attention(q, k_cache, v_cache, start, fill):
+    """XLA oracle for the k-query (speculative verify) variant: query i of a
+    row attends over cache slots [start, fill + i + 1) — the valid prefix
+    plus the candidate tokens up to and including itself (their KV is
+    already written at slots [fill, fill + Tq)). q: [B, H, Tq, hd];
+    k/v: [B, KV, T, hd]; start/fill: [B] int32. Returns [B, H, Tq, hd]."""
+    B, H, Tq, hd = q.shape
+    KV, T = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    qg = q.reshape(B, KV, G, Tq, hd)
+    s = jnp.einsum("bkgqh,bkth->bkgqt", qg, k_cache).astype(jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(hd))
+    pos = jnp.arange(T)[None, None, :]                       # [1, 1, T]
+    qi = jnp.arange(Tq)[None, :, None]                       # [1, Tq, 1]
+    valid = (pos >= start[:, None, None]) & (
+        pos < fill[:, None, None] + qi + 1
+    )                                                        # [B, Tq, T]
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgqt,bkth->bkgqh", p, v_cache)
+    return out.reshape(B, H, Tq, hd)
+
+
+def _verify_kernel(start_ref, fill_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale: float, block_k: int,
+                   Tq: int):
+    """k-query generalization of `_decode_kernel`: the query block carries
+    G*Tq rows (row r = g*Tq + qi) and the per-row key bound becomes
+    fill + qi + 1 — the causal-within-candidates rule. Same prefix-clamped
+    grid + online softmax as the single-query kernel."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_blk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+    fill = fill_ref[b]
+    first_blk = start // block_k
+    last_blk = (fill + Tq - 1) // block_k
+    actual_j = jnp.minimum(first_blk + j, last_blk)
+
+    @pl.when(first_blk + j <= last_blk)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [Rp, hd]
+        k = k_ref[0, 0].astype(jnp.float32)              # [block_k, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [Rp, block_k]
+        pos = actual_j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        qi = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % Tq
+        s = jnp.where((pos >= start) & (pos < fill + qi + 1), s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == n_blk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_verify_attention(
+    q: jnp.ndarray,        # [B, H, Tq, hd] — k+1 candidate positions
+    k_cache: jnp.ndarray,  # [B, KV, T_max, hd] (candidate KV already written)
+    v_cache: jnp.ndarray,  # [B, KV, T_max, hd]
+    start: jnp.ndarray,    # [B] int32: first valid cache slot
+    fill: jnp.ndarray,     # [B] int32: slot of candidate 0 (query i owns
+                           # slot fill + i; it attends to [start, fill+i+1))
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Prefix-bounded decode attention for a BLOCK of Tq candidate queries —
+    the speculative-verify variant of `decode_attention` (interpret fallback
+    off-TPU, like every kernel here). One kernel pass scores all k+1
+    candidates against the cache, so the dominant weight/cache HBM stream is
+    paid once per verify step instead of once per token. Returns
+    [B, H, Tq, hd]."""
+    B, H, Tq, hd = q.shape
+    KV, T = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    R = G * Tq
+    Rp = 8 * pl.cdiv(R, 8)  # sublane-pad the flattened (group, query) rows
+    block_k = min(block_k, max(128, 128 * pl.cdiv(T, 128)))
+
+    # [B, KV, G, Tq, hd] -> [B, KV, G*Tq, hd]; row r = g*Tq + qi, so the
+    # kernel recovers the query index as r % Tq (padded rows compute a
+    # garbage qi and are sliced off after the call)
+    qg = q.reshape(B, KV, G, Tq, hd).reshape(B, KV, R, hd)
+    if Rp != R:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Rp - R), (0, 0)])
+
+    if T % block_k != 0:
+        pad_t = block_k * pl.cdiv(T, block_k) - T
+        padz = [(0, 0), (0, 0), (0, pad_t), (0, 0)]
+        k_cache = jnp.pad(k_cache, padz)
+        v_cache = jnp.pad(v_cache, padz)
+        T = T + pad_t
+    n_blk = T // block_k
+
+    kernel = functools.partial(
+        _verify_kernel, scale=1.0 / (hd ** 0.5), block_k=block_k, Tq=Tq
+    )
+
+    def kv_index_map(b, kv, j, start_ref, fill_ref):
+        first = start_ref[b] // block_k
+        last = jnp.maximum((fill_ref[b] + Tq - 1) // block_k, 0)
+        return (b, kv, jnp.minimum(first + j, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, n_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, Rp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Rp, hd), lambda b, kv, j, s, f: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Rp, hd), jnp.float32),
+            pltpu.VMEM((Rp, 128), jnp.float32),
+            pltpu.VMEM((Rp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Rp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), fill.astype(jnp.int32), qg, k_cache, v_cache)
+    return out[:, :, :R, :].reshape(B, KV, G, Tq, hd).reshape(B, H, Tq, hd)
+
+
 def decode_attention(
     q: jnp.ndarray,        # [B, H, hd] — single decode position
     k_cache: jnp.ndarray,  # [B, KV, T_max, hd]

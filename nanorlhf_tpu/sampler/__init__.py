@@ -1,3 +1,6 @@
 from nanorlhf_tpu.sampler.sampler import SamplingParams, generate, generate_tokens
+from nanorlhf_tpu.sampler.speculative import generate_tokens_spec
 
-__all__ = ["SamplingParams", "generate", "generate_tokens"]
+__all__ = [
+    "SamplingParams", "generate", "generate_tokens", "generate_tokens_spec",
+]

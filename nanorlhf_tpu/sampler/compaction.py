@@ -24,6 +24,15 @@ Interaction with `rollout_ahead`: this path blocks the host at every
 segment boundary, so a prefetch-dispatched compacting rollout executes
 eagerly inside dispatch() instead of overlapping — combine them only when
 reward grading is the dominant host cost and segments are coarse.
+
+Interaction with speculative decode (`SamplingParams.spec_k`,
+sampler/speculative.py): MUTUALLY EXCLUSIVE — the row gather above moves
+KV caches without touching slot layout precisely because all live rows
+share the same step alignment (row r's token t always sits in slot Tp+t),
+while speculative accept lengths advance rows at different rates and break
+that invariant. `generate` raises on the combination; pick compaction for
+straggler-dominated length distributions, spec_k for self-repetitive
+corpora.
 """
 
 from __future__ import annotations

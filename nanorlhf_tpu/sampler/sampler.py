@@ -76,7 +76,31 @@ class SamplingParams:
     # live rows gathered into a smaller power-of-two batch — the
     # static-shape analogue of vLLM's continuous batching. 0 = monolithic
     # single-jit loop (bit-stable row streams, fully async dispatch).
+    # Mutually exclusive with spec_k > 0: compaction's row gather assumes
+    # every live row sits at the same decode step (shared cache-slot
+    # layout), which speculative decode's per-row accept lengths break —
+    # `generate` raises on the combination.
     compaction_segments: int = 0
+    # >0 enables draft-free speculative decode (sampler/speculative.py): a
+    # jitted n-gram/prompt-lookup drafter proposes spec_k tokens per row
+    # from the row's own prompt+output buffer, and ONE `decode_verify`
+    # forward scores all k+1 candidates against the cache — amortizing the
+    # dominant per-step weight/cache HBM stream over every accepted token
+    # (docs/DECODE_ANALYSIS.md). Greedy rows accept the matched prefix
+    # bit-exactly vs this monolithic loop; sampled rows use Leviathan/Chen
+    # rejection sampling against the SAME filtered distribution
+    # `_sample_token` draws from, so the output distribution is provably
+    # unchanged (different PRNG stream, though — spec draws accept/residual
+    # variates instead of one categorical per step). capture_logprobs
+    # reuses the verify logits, so accepted tokens still carry
+    # full-distribution logprobs. 0 = this loop, bit-for-bit untouched.
+    # Incompatible with compaction_segments > 0 (see above).
+    spec_k: int = 0
+    # n-gram context length the drafter matches on (spec_k > 0 only):
+    # smaller = more matches (higher draft rate, lower precision), larger =
+    # fewer but better drafts. 3 suits R1-style self-repetitive math
+    # rollouts (restated problem text, \boxed{} scaffolding).
+    spec_ngram: int = 3
     # n>1: prefill each prompt ONCE and fan the prompt KV out to its N
     # samples inside the jit, instead of repeating the prompt rows before
     # prefill — ÷N prefill FLOPs and prompt activation memory, the
@@ -150,6 +174,57 @@ def top_p_filter_bisect(logits: jnp.ndarray, top_p: float,
     return jnp.where(probs >= lo, logits, -jnp.inf)
 
 
+def _nucleus_candidates(logits, top_p, top_k, approx_top_k):
+    """(top_logits, top_idx, keep): the top-k candidate set plus the
+    exclusive-cum nucleus keep rule over TRUE probabilities (full-vocab
+    logsumexp normalization, so the keep set matches the exact filter).
+    The single copy of the candidate-selection semantics, shared by
+    `_sample_token`'s k-space categorical and the speculative verifier's
+    full-vocab rejection filter (`filtered_logits_full`) — the two paths
+    must agree on the keep set or spec decode would change the sampling
+    distribution. `logits` arrive already temperature-scaled."""
+    k = min(top_k, logits.shape[-1])
+    if approx_top_k and k < logits.shape[-1]:
+        # hardware-native approximate top-k (exact lax.top_k is a full-vocab
+        # sort on TPU); aggregate_to_topk (default) already returns the
+        # candidates exactly sorted descending
+        top_logits, top_idx = jax.lax.approx_max_k(
+            logits, k, recall_target=0.99
+        )
+    else:
+        top_logits, top_idx = jax.lax.top_k(logits, k)  # descending
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    probs = jnp.exp(top_logits - lse)                   # true (unrenormalized) probs
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p                        # exclusive-cum; first always kept
+    return top_logits, top_idx, keep
+
+
+def filtered_logits_full(logits, temperature, top_p, top_k, approx_top_k):
+    """Full-vocab filtered/temperature-scaled logits whose softmax is
+    EXACTLY the distribution `_sample_token` draws from (same candidate
+    set + keep rule via `_nucleus_candidates`; -inf outside the keep set).
+    The speculative verifier's rejection sampler needs the distribution as
+    a dense vocab vector (accept prob of an arbitrary drafted token +
+    residual sampling with that token removed), which the k-space
+    categorical never materializes. Supports any leading batch shape."""
+    scaled = logits.astype(jnp.float32) / guard_temperature(temperature)
+    if top_p >= 1.0:
+        return scaled
+    if top_k <= 0:
+        return top_p_filter_bisect(scaled, top_p)
+    lead = scaled.shape[:-1]
+    V = scaled.shape[-1]
+    flat = scaled.reshape(-1, V)
+    top_logits, top_idx, keep = _nucleus_candidates(
+        flat, top_p, top_k, approx_top_k
+    )
+    kept = jnp.where(keep, top_logits, -jnp.inf)
+    rows = jnp.arange(flat.shape[0])[:, None]
+    full = jnp.full_like(flat, -jnp.inf).at[rows, top_idx].set(kept)
+    return full.reshape(*lead, V)
+
+
 def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
                   approx_top_k=True):
     """Sample one token per row.
@@ -172,20 +247,9 @@ def _sample_token(key, logits, temperature, top_p, greedy, top_k=64,
             # exact full-vocab nucleus, sort-free (bisection threshold)
             logits = top_p_filter_bisect(logits, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
-    k = min(top_k, logits.shape[-1])
-    if approx_top_k and k < logits.shape[-1]:
-        # hardware-native approximate top-k (exact lax.top_k is a full-vocab
-        # sort on TPU); aggregate_to_topk (default) already returns the
-        # candidates exactly sorted descending
-        top_logits, top_idx = jax.lax.approx_max_k(
-            logits, k, recall_target=0.99
-        )
-    else:
-        top_logits, top_idx = jax.lax.top_k(logits, k)  # descending
-    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
-    probs = jnp.exp(top_logits - lse)                   # true (unrenormalized) probs
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_p                        # exclusive-cum; first always kept
+    top_logits, top_idx, keep = _nucleus_candidates(
+        logits, top_p, top_k, approx_top_k
+    )
     top_logits = jnp.where(keep, top_logits, -jnp.inf)
     choice = jax.random.categorical(key, top_logits, axis=-1)
     return jnp.take_along_axis(
@@ -261,7 +325,7 @@ def generate_tokens(
 def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
                    max_tokens, eos_token_id, pad_token_id, temperature,
                    top_p, greedy, lora_scale, top_k, capture_logprobs,
-                   approx_top_k, prompt_fanout=1):
+                   approx_top_k, prompt_fanout=1, cache_extra=0):
     """Prefill + first sampled token → the decode-loop carry state:
     (step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key).
     Per-step sampling keys are fold_in(key, step), so a segment boundary
@@ -274,9 +338,14 @@ def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
     including the [B*N]-shaped categorical draw — is then identical to
     prefilling N repeated copies, at 1/N the prefill FLOPs. The interleaved
     repeat is collective-free under a data-sharded batch: each device's row
-    block fans out to its own contiguous output block."""
+    block fans out to its own contiguous output block.
+
+    `cache_extra` pads the KV cache/key_mask past Tp + max_tokens — the
+    speculative path (spec_k slack) needs room for a full k+1 candidate
+    write when a row sits one token short of the budget; 0 (every other
+    caller) keeps shapes bit-identical to before."""
     B, Tp = prompt_ids.shape
-    T_max = Tp + max_tokens
+    T_max = Tp + max_tokens + cache_extra
     prompt_mask = prompt_mask.astype(bool)
     dtype = params["embed_tokens"].dtype
 
@@ -347,12 +416,24 @@ def generate(
     pad_token_id: int,
     lora_scale: float = 1.0,
     batch_sharding=None,
+    spec_stats_out: list | None = None,
+    tracer=None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
     prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
 
     `batch_sharding` (optional NamedSharding over the batch axes) is only
-    consumed by the compacting path, which re-lays-out gathered carries."""
+    consumed by the compacting path, which re-lays-out gathered carries.
+
+    `spec_stats_out` (spec_k > 0 only): a caller-provided list the
+    speculative path appends its per-call stats dict to (device scalars:
+    verify steps, drafted/accepted/emitted token counts) — the trainer's
+    rollout/draft_acceptance metrics and bench's detail.spec_decode read
+    it without changing the return contract. `tracer` (an enabled
+    telemetry.SpanTracer) switches the speculative path to its
+    host-driven loop with real per-iteration "rollout.draft"/
+    "rollout.verify" spans (one sync per verify step — observability
+    mode, not the fully-async default)."""
     fanout = 1
     if sampling.n > 1:
         if sampling.shared_prompt_prefill:
@@ -361,6 +442,30 @@ def generate(
         else:
             prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
             prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
+    if sampling.spec_k > 0:
+        if sampling.compaction_segments > 0:
+            raise ValueError(
+                "spec_k > 0 is incompatible with compaction_segments > 0: "
+                "compacting decode gathers rows under the assumption that "
+                "every live row sits at the same decode step (shared "
+                "cache-slot layout, sampler/compaction.py), which "
+                "speculative decode's per-row accept lengths break. Pick "
+                "one lever: spec_k for repetitive/self-similar rollouts, "
+                "compaction for straggler-dominated length distributions."
+            )
+        from nanorlhf_tpu.sampler.speculative import generate_spec
+
+        return generate_spec(
+            params, config, prompt_ids, prompt_mask, key,
+            max_tokens=sampling.max_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, spec_k=sampling.spec_k,
+            spec_ngram=sampling.spec_ngram,
+            temperature=sampling.temperature, top_p=sampling.top_p,
+            greedy=sampling.greedy, lora_scale=lora_scale,
+            top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
+            approx_top_k=sampling.approx_top_k, prompt_fanout=fanout,
+            spec_stats_out=spec_stats_out, tracer=tracer,
+        )
     if sampling.compaction_segments > 0:
         from nanorlhf_tpu.sampler.compaction import generate_tokens_compact
 

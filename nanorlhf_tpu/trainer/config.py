@@ -76,6 +76,23 @@ class RLConfig:
     # to the repeat path, test-pinned). Off = repeat every prompt ×N before
     # prefill (ablation/debug).
     rollout_shared_prefill: bool = True
+    # >0: draft-free speculative rollout decode (sampler/speculative.py,
+    # docs/DECODE_ANALYSIS.md): an n-gram/prompt-lookup drafter proposes
+    # this many tokens per row from the row's own prompt+output buffer and
+    # one batched `decode_verify` forward scores all k+1 candidates —
+    # amortizing the HBM-bound per-step weight/cache stream over every
+    # accepted token. Greedy rollouts stay bit-exact; sampled rollouts are
+    # distribution-exact (rejection sampling). Best on self-repetitive
+    # corpora (R1-style math: restated problem text, \boxed{} templates);
+    # worst case (acceptance ~0) pays ~one verify forward per token.
+    # Per-update acceptance lands in rollout/draft_acceptance /
+    # rollout/accepted_per_step (docs/METRICS.md). 0 = off (the monolithic
+    # loop, bit-for-bit untouched). Incompatible with
+    # rollout_compaction_segments > 0 — `generate` raises (compaction's
+    # row gather assumes step-aligned rows).
+    rollout_spec_k: int = 0
+    # n-gram context the drafter matches on (rollout_spec_k > 0 only)
+    rollout_spec_ngram: int = 3
 
     # ---- batch hierarchy ----
     # total_episodes=None → num_train_epochs × dataset size, resolved by the

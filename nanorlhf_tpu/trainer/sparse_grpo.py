@@ -336,6 +336,7 @@ class SparseGRPOTrainer(RLTrainer):
             compaction_segments=cfg.rollout_compaction_segments,
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
             shared_prompt_prefill=cfg.rollout_shared_prefill,
+            spec_k=cfg.rollout_spec_k, spec_ngram=cfg.rollout_spec_ngram,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])
@@ -351,12 +352,15 @@ class SparseGRPOTrainer(RLTrainer):
                 # disaggregated rollouts: prompts land on the generation
                 # mesh; _rollout_params() re-shards the param view there
                 q_j = jax.device_put(q_j, batch_sharding(self.rollout_mesh))
+            spec_stats: list = []
             gen_out = generate(
                 self._rollout_params(), self._rollout_mcfg, q_j, q_j != pad_id, gk,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
+                spec_stats_out=spec_stats, tracer=self.tracer,
             )
-            return {"queries": queries, "gen_out": gen_out}
+            return {"queries": queries, "gen_out": gen_out,
+                    "spec_stats": spec_stats[0] if spec_stats else None}
 
         stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
         for update in range(1, n_updates + 1):
@@ -642,6 +646,9 @@ class SparseGRPOTrainer(RLTrainer):
                 ),
                 "episode": self.state["episode"],
             }
+            # speculative-decode acceptance rows: the dense loop's one
+            # definition (RLTrainer._spec_decode_metrics, docs/METRICS.md)
+            metrics.update(self._spec_decode_metrics(ro.get("spec_stats")))
             # perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md): the
             # dense loop's napkin model with sparse-runtime token counts —
             # scoring/update tokens count only the KEPT (post-filter) rows

@@ -706,6 +706,31 @@ class RLTrainer:
         also measured for serial / rollout_ahead runs) — bench reads this."""
         return self._rollout_meter.overlap_fraction()
 
+    @staticmethod
+    def _spec_decode_metrics(spec_stats) -> dict:
+        """rollout/draft_acceptance + accepted_per_step + spec_verify_steps
+        rows (docs/METRICS.md) from a speculative-decode stats dict — the
+        ONE definition of these metrics, shared by the dense and sparse
+        loops so the two runtimes can never report differently-defined
+        series under the same names. {} when the lever is off."""
+        if spec_stats is None:
+            return {}
+        v_steps = float(np.asarray(spec_stats["verify_steps"]))
+        return {
+            # fraction of drafted tokens accepted; tokens emitted per live
+            # row per verify dispatch (the monolithic loop's is identically
+            # 1); and the dispatch count itself
+            "rollout/draft_acceptance": (
+                float(np.asarray(spec_stats["accepted"]))
+                / max(float(np.asarray(spec_stats["drafted"])), 1.0)
+            ),
+            "rollout/accepted_per_step": (
+                float(np.asarray(spec_stats["emitted"]))
+                / max(float(np.asarray(spec_stats["row_steps"])), 1.0)
+            ),
+            "rollout/spec_verify_steps": v_steps,
+        }
+
     # ------------------------------------------------------------------ #
     # telemetry: perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md)
     # ------------------------------------------------------------------ #
@@ -1232,6 +1257,7 @@ class RLTrainer:
             compaction_segments=cfg.rollout_compaction_segments,
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
             shared_prompt_prefill=cfg.rollout_shared_prefill,
+            spec_k=cfg.rollout_spec_k, spec_ngram=cfg.rollout_spec_ngram,
         )
 
         # after a resume, the default budget is the REMAINING updates, not a
@@ -1262,10 +1288,18 @@ class RLTrainer:
             queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
             gen_params = self._rollout_params(gen_tree)
+            # speculative decode (rollout_spec_k > 0) appends its acceptance
+            # counters here — device scalars fetched at metrics time, after
+            # the tokens already forced a sync. The tracer hands the spec
+            # path its instrumented driver (draft/verify spans on the
+            # "rollout" track) when telemetry is on; a disabled tracer is
+            # ignored.
+            spec_stats: list = []
             gen_out = generate(
                 gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale, batch_sharding=bs,
+                spec_stats_out=spec_stats, tracer=self.tracer,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:
@@ -1276,7 +1310,8 @@ class RLTrainer:
                     eos_token_id=eos_id, pad_token_id=pad_id,
                     lora_scale=self.lora_scale,
                 )
-            return {"queries": queries, "gen_out": gen_out, "greedy": greedy}
+            return {"queries": queries, "gen_out": gen_out, "greedy": greedy,
+                    "spec_stats": spec_stats[0] if spec_stats else None}
 
         from nanorlhf_tpu.orchestrator import ProducerFailed
         from nanorlhf_tpu.resilience import Preempted, ProducerWatchdog
@@ -1720,6 +1755,7 @@ class RLTrainer:
             # (serial ≈ 0, rollout_ahead partial, orchestrator highest) —
             # the bench payload's pipelining signal
             metrics["time/rollout_overlap_frac"] = meter.overlap_fraction()
+            metrics.update(self._spec_decode_metrics(ro.get("spec_stats")))
             if use_orch:
                 ostats = orch.stats()
                 metrics.update({

@@ -11,6 +11,13 @@ levers (see docs/ROADMAP.md #2):
   int8_kv       — kv_cache_quant="int8" + q8 decode kernel
   int8_both     — both quantizations
   compact4      — rollout_compaction_segments=4 (continuous-batching analogue)
+  spec{2,4,8}   — speculative decode (sampler/speculative.py): n-gram draft
+                  + batched k-token verify at spec_k ∈ {2,4,8}, nucleus
+                  sampling (the spec_k=0 nucleus baseline IS approx_topk)
+  greedy0       — greedy decode baseline (spec_k=0)
+  greedy_spec{2,4,8} — greedy speculative decode; greedy accept is bit-exact
+                  vs greedy0, so the sec_steady delta is pure mechanism cost
+                  /win at the measured acceptance (printed per lever)
   n4_shared     — n=4 samples/prompt with shared-prompt-KV prefill (r5
                   default; vLLM prefix-sharing analogue)
   n4_repeat     — n=4 with the repeat-×N prefill (the pre-r5 path); the
@@ -99,7 +106,18 @@ def main():
             "n4_shared": dict(base, sp_kw={"n": 4}),
             "n4_repeat": dict(base, sp_kw={"n": 4,
                                            "shared_prompt_prefill": False}),
+            # speculative decode, spec_k x {greedy, nucleus} (ISSUE 5): the
+            # spec_k=0 nucleus baseline is approx_topk above; greedy0 is the
+            # greedy baseline. Acceptance on this random-prompt corpus is
+            # the pessimistic floor — the roofline row in
+            # docs/DECODE_ANALYSIS.md projects the repetitive-corpus case.
+            "greedy0": dict(base, sp_kw={"greedy": True}),
         }
+        for sk in (2, 4, 8):
+            levers[f"spec{sk}"] = dict(base, sp_kw={"spec_k": sk})
+            levers[f"greedy_spec{sk}"] = dict(
+                base, sp_kw={"greedy": True, "spec_k": sk}
+            )
         wanted = (lever_env.split(",") if lever_env else list(levers))
         if "int8_weights" in wanted or "int8_both" in wanted:
             q_params = rollout_view(params, quantize_layers(params["layers"]))
@@ -116,31 +134,46 @@ def main():
             )
             # warmup (compile) + 2 timed reps
             times = []
+            spec_stats: list = []
             for rep in range(3):
                 t0 = time.time()
                 out = generate(spec["params"], spec["mcfg"], ids_j, mask_j,
                                jax.random.PRNGKey(rep), sp,
                                eos_token_id=tok.eos_token_id,
-                               pad_token_id=tok.pad_token_id)
+                               pad_token_id=tok.pad_token_id,
+                               spec_stats_out=spec_stats)
                 np.asarray(out)  # full fetch = honest sync
                 times.append(time.time() - t0)
             steady = float(np.mean(times[1:]))
             n_rows = out.shape[0]  # rows × n for the fanout levers
             toks = n_rows * resp / steady
             results[(name, resp)] = toks
-            print(json.dumps({
+            row = {
                 "lever": name, "response_length": resp, "rows": n_rows,
                 "sec_steady": round(steady, 3), "compile_sec": round(times[0], 1),
                 "decode_tokens_per_sec": round(toks, 1),
-            }), flush=True)
+            }
+            if spec_stats:
+                st = {k: int(np.asarray(v)) for k, v in spec_stats[-1].items()}
+                row["spec_acceptance"] = round(
+                    st["accepted"] / max(st["drafted"], 1), 4
+                )
+                row["spec_accepted_per_step"] = round(
+                    st["emitted"] / max(st["row_steps"], 1), 3
+                )
+                row["spec_verify_steps"] = st["verify_steps"]
+            print(json.dumps(row), flush=True)
 
     base_key = ("approx_topk", lengths[-1])
     # n4_* levers decode rows×4 physical rows — their raw tokens/s scales
     # with batch size, so they must not enter the cross-lever best/speedup
     # (which would crown them on a batch-size artifact). Their meaningful
     # number is the PAIRWISE shared-vs-repeat ratio, reported separately.
+    # greedy* levers likewise: greedy decode skips the nucleus math the
+    # headline pays, so they compare only within the greedy family (the
+    # greedy_specK / greedy0 pairwise ratios below).
     same_batch = {k: v for k, v in results.items()
-                  if not k[0].startswith("n4_")}
+                  if not k[0].startswith(("n4_", "greedy"))}
     summary = {
         "metric": "decode_ablation",
         "device": dev.device_kind,
@@ -159,6 +192,12 @@ def main():
             summary[f"n4_shared_speedup_vs_repeat@{resp}"] = round(
                 results[a] / results[b], 3
             )
+        for sk in (2, 4, 8):
+            g, g0 = (f"greedy_spec{sk}", resp), ("greedy0", resp)
+            if g in results and g0 in results:
+                summary[f"greedy_spec{sk}_speedup@{resp}"] = round(
+                    results[g] / results[g0], 3
+                )
     print(json.dumps(summary), flush=True)
 
 
