@@ -39,6 +39,10 @@ the step wall, docs/RESILIENCE.md),
 BENCH_TELEMETRY (1: also measure with the span tracer enabled and report
 detail.telemetry.telemetry_overhead_frac — the observability acceptance
 gate is < 1% of step wall, docs/OBSERVABILITY.md),
+BENCH_FLEET_WORKERS (0: >1 also measures the elastic rollout fleet at that
+worker count against the single-producer pipeline at the SAME staleness
+and reports detail.fleet.coordinator_overhead_frac — the lease/reorder
+machinery's cost on the step wall; acceptance < 2%, docs/FLEET.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -568,6 +572,7 @@ def run_bench(jax, init_error):
     orch_staleness = int(os.environ.get("BENCH_STALENESS", "2"))
     kv_cache_quant = "int8" if os.environ.get("BENCH_KV_QUANT", "0") == "1" else "none"
     spec_k_env = int(os.environ.get("BENCH_SPEC_K", "0"))
+    fleet_workers_env = int(os.environ.get("BENCH_FLEET_WORKERS", "0"))
     # BENCH_SWEEP=1 (default on real TPU): after the baseline, ALSO measure
     # the int8 rollout levers and report the faster config as the headline.
     # A lever failure (lowering, numerics) falls back to the already-measured
@@ -614,7 +619,7 @@ def run_bench(jax, init_error):
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
-                telemetry=False, spec_k=None):
+                telemetry=False, spec_k=None, workers=1):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -645,6 +650,7 @@ def run_bench(jax, init_error):
             rollout_quant=r_quant,
             rollout_ahead=ahead and not orchestrator,
             rollout_orchestrator=orchestrator,
+            rollout_workers=workers if orchestrator else 1,
             max_staleness=staleness,
             sentinel=sentinel,
             telemetry=telemetry,
@@ -678,6 +684,7 @@ def run_bench(jax, init_error):
             "fused_logprob": cfg.fused_logprob,
             "rollout_ahead": cfg.rollout_ahead,
             "rollout_orchestrator": orchestrator,
+            "rollout_workers": workers if orchestrator else None,
             "max_staleness": staleness if orchestrator else None,
             "rollout_shared_prefill": cfg.rollout_shared_prefill,
             "rollout_spec_k": spec_k,
@@ -868,6 +875,47 @@ def run_bench(jax, init_error):
         except Exception as e:
             telemetry_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # fleet-coordinator overhead A/B (docs/FLEET.md acceptance: the lease /
+    # reorder-buffer / liveness machinery costs < 2% of step wall): measure
+    # the single-producer pipeline and the N-worker fleet at the SAME
+    # staleness (>= N so every worker can hold a lease) and report the
+    # relative delta. Generation work is identical — the delta isolates
+    # coordination cost. Opt-in via BENCH_FLEET_WORKERS >= 2; two extra
+    # measured configs, so gated on a wider budget margin.
+    fleet_detail = None
+    if (fleet_workers_env >= 2
+            and budget - (time.time() - _T0) > 1.8 * t_baseline):
+        fleet_staleness = max(orch_staleness, fleet_workers_env)
+        try:
+            single = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"], False,
+                orchestrator=True, staleness=fleet_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
+            )
+            fleet = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"], False,
+                orchestrator=True, staleness=fleet_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
+                workers=fleet_workers_env,
+            )
+            single_sec = single["sec_per_update_steady"]
+            fleet_sec = fleet["sec_per_update_steady"]
+            fleet_detail = {
+                "workers": fleet_workers_env,
+                "max_staleness": fleet_staleness,
+                "single_producer_sec_per_update": single_sec,
+                "fleet_sec_per_update": fleet_sec,
+                "single_producer_overlap_frac": single[
+                    "rollout_train_overlap_frac"
+                ],
+                "fleet_overlap_frac": fleet["rollout_train_overlap_frac"],
+                "coordinator_overhead_frac": round(
+                    (fleet_sec - single_sec) / max(single_sec, 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            fleet_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # secondary short-response point (the r1/r2 rounds' resp-256 shape) so
     # the payload carries BOTH operating points — the resp-1500 headline
     # stays baseline-comparable and the short point tracks decode-lever
@@ -986,6 +1034,8 @@ def run_bench(jax, init_error):
         detail["sentinel"] = sentinel_detail
     if telemetry_detail is not None:
         detail["telemetry"] = telemetry_detail
+    if fleet_detail is not None:
+        detail["fleet"] = fleet_detail
     if short_detail is not None:
         detail["short_response"] = short_detail
     if init_error is not None:

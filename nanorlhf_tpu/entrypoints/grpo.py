@@ -11,6 +11,7 @@ from nanorlhf_tpu.trainer import AlgoName, RLConfig
 def build_config(sequence_parallel: int = 1,
                  rollout_staleness: int | None = None,
                  rollout_devices: int = 0,
+                 rollout_workers: int = 1,
                  rollout_spec_k: int = 0) -> RLConfig:
     """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
     update through ring attention with the sequence dim sharded over an sp
@@ -21,6 +22,14 @@ def build_config(sequence_parallel: int = 1,
     capture so the truncated-IS off-policy correction has the behavior
     logprobs it needs; pair with `rollout_devices > 0` to give generation
     its own device group so it truly never waits on the train step.
+
+    `rollout_workers > 1` generalizes the pipeline into the elastic rollout
+    fleet (docs/FLEET.md): N independent, preemptible workers under leased
+    work with reassignment/quarantine fault tolerance. Implies the
+    orchestrator; staleness defaults to the worker count (the gate bounds
+    in-flight leases, so fewer stale steps would idle workers). With
+    `rollout_devices > 0` the reserved group is split into per-worker
+    meshes (rollout_devices must divide by rollout_workers).
 
     `rollout_spec_k > 0` turns on draft-free speculative rollout decode
     (sampler/speculative.py, distribution-exact); composes with every knob
@@ -67,6 +76,13 @@ def build_config(sequence_parallel: int = 1,
         cfg.rollout_orchestrator = True
         cfg.max_staleness = rollout_staleness
         cfg.sampler_logprob_capture = True  # behavior logprobs for the IS fix
+    if rollout_workers > 1:
+        cfg.rollout_orchestrator = True
+        cfg.rollout_workers = rollout_workers
+        cfg.sampler_logprob_capture = True
+        if rollout_staleness is None:
+            # N workers need N leases in flight to all stay busy
+            cfg.max_staleness = rollout_workers
     if rollout_devices > 0:
         cfg.rollout_devices = rollout_devices
     cfg.rollout_spec_k = rollout_spec_k

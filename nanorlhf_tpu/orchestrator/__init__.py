@@ -1,5 +1,6 @@
 """Async rollout orchestration: version-tagged weights, bounded-staleness
-sample queue, producer-thread rollout pipeline (docs/ORCHESTRATOR.md)."""
+sample queue, producer-thread rollout pipeline (docs/ORCHESTRATOR.md), and
+the N-worker elastic rollout fleet (docs/FLEET.md)."""
 
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
 from nanorlhf_tpu.orchestrator.sample_queue import (
@@ -12,13 +13,31 @@ from nanorlhf_tpu.orchestrator.orchestrator import (
     RolloutOrchestrator,
     note_ready_async,
 )
+from nanorlhf_tpu.orchestrator.fleet import (
+    FleetConfig,
+    FleetCoordinator,
+    FleetExhausted,
+    FleetOrchestrator,
+    FleetTransport,
+    InProcessTransport,
+    Lease,
+    RolloutWorker,
+)
 
 __all__ = [
     "BoundedStalenessQueue",
+    "FleetConfig",
+    "FleetCoordinator",
+    "FleetExhausted",
+    "FleetOrchestrator",
+    "FleetTransport",
+    "InProcessTransport",
+    "Lease",
     "OverlapMeter",
     "ProducerFailed",
     "QueuedSample",
     "RolloutOrchestrator",
+    "RolloutWorker",
     "VersionedWeightStore",
     "note_ready_async",
 ]

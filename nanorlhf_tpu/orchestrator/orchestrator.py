@@ -70,15 +70,29 @@ class OverlapMeter:
     trainer work. 0 for the serial trainer (generation only runs while the
     consumer waits); → 1 when the pipeline fully hides generation.
 
+    With N producers (the rollout fleet) the generation windows of
+    different workers legitimately OVERLAP each other; the union in the
+    numerator/denominator counts concurrently-generating wall-clock once,
+    which is the honest "fraction of generation time hidden by training"
+    reading. Producers tag their intervals with a per-producer `track`
+    (fleet workers use their worker id); the default track 0 reproduces
+    the single-producer behavior exactly.
+
     The metric is cumulative over the trainer's lifetime but the interval
     history is NOT: past `_COMPACT_AT` stored intervals the prefix below a
     watermark is folded into scalar accumulators (overlap seconds + gen
     seconds), so a long run pays O(_COMPACT_AT) per reading instead of an
-    ever-growing sweep. The watermark is the minimum of the two streams'
-    latest recorded end-times: both streams record chronologically
-    non-overlapping windows, so every FUTURE interval starts at or after
-    it — clipping both histories at the watermark makes the folded /
-    retained decomposition exact, not an approximation.
+    ever-growing sweep. The watermark is the minimum over every track (gen
+    and busy) of that track's latest recorded end-time: each TRACK records
+    chronologically non-overlapping windows (a worker's next dispatch
+    starts after its previous sample is device-ready), so every FUTURE
+    interval starts at or after its own track's last end ≥ the watermark —
+    clipping both histories there makes the folded / retained
+    decomposition exact, not an approximation. (Taking the min over the
+    raw append order instead would be wrong with N producers: arrivals
+    interleave, so the last-appended interval's end is not a lower bound
+    on future starts.) A producer that leaves for good must be retired
+    (`retire_gen_track`) or its stale watermark pins compaction forever.
     """
 
     _COMPACT_AT = 4096
@@ -87,25 +101,45 @@ class OverlapMeter:
         self._lock = threading.Lock()
         self._gen: list[tuple[float, float]] = []
         self._busy: list[tuple[float, float]] = []
+        self._gen_ends: dict[int, float] = {}    # track -> latest end time
+        self._busy_ends: dict[int, float] = {}
         self._overlap_acc = 0.0   # folded prefix: overlap seconds
         self._gen_acc = 0.0       # folded prefix: generation seconds
 
-    def note_gen(self, t0: float, t1: float) -> None:
+    def note_gen(self, t0: float, t1: float, track: int = 0) -> None:
         with self._lock:
             self._gen.append((t0, t1))
+            self._gen_ends[track] = max(self._gen_ends.get(track, t1), t1)
             self._maybe_compact()
 
-    def note_busy(self, t0: float, t1: float) -> None:
+    def note_busy(self, t0: float, t1: float, track: int = 0) -> None:
         with self._lock:
             self._busy.append((t0, t1))
+            self._busy_ends[track] = max(self._busy_ends.get(track, t1), t1)
             self._maybe_compact()
+
+    def retire_gen_track(self, track: int) -> None:
+        """A producer left the fleet for good: stop holding the compaction
+        watermark down at its last recorded window."""
+        with self._lock:
+            self._gen_ends.pop(track, None)
 
     def _maybe_compact(self) -> None:
         # caller holds the lock
         if len(self._gen) + len(self._busy) < self._COMPACT_AT \
                 or not self._gen or not self._busy:
             return
-        cutoff = min(self._gen[-1][1], self._busy[-1][1])
+        if not self._gen_ends or not self._busy_ends:
+            # every producing track on one side was retired while its
+            # intervals are still retained (e.g. all fleet workers lost
+            # before the degraded fallback records again): no watermark
+            # exists, so skip — the next note_gen/note_busy re-adds a
+            # track (whose windows start later in wall-clock) and
+            # compaction resumes
+            return
+        cutoff = min(
+            min(self._gen_ends.values()), min(self._busy_ends.values())
+        )
 
         def clip(ivs):
             below, above = [], []

@@ -101,6 +101,15 @@ class BoundedStalenessQueue:
                 self.producer_gate_wait_s += time.perf_counter() - t0
             return False
 
+    def may_produce(self, index: int) -> bool:
+        """Non-blocking `wait_to_produce` gate check — the fleet coordinator
+        holds its own lock while sizing leases and cannot block in here; it
+        re-polls on its own wait cadence instead. Capacity is NOT checked:
+        the coordinator's in-order reorder buffer means granted-but-unqueued
+        indices already bound queue depth via this same staleness gate."""
+        with self._cond:
+            return (index - self._base) - self._version <= self.max_staleness
+
     def put(self, sample: QueuedSample) -> None:
         with self._cond:
             self._q.append(sample)

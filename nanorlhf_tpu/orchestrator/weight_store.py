@@ -22,7 +22,7 @@ the store is plain host-side bookkeeping (no jax import).
 from __future__ import annotations
 
 import threading
-from typing import Any
+from typing import Any, Optional
 
 
 class VersionedWeightStore:
@@ -35,25 +35,66 @@ class VersionedWeightStore:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
         self._version = -1
         self._tree: Any = None
 
     @property
     def version(self) -> int:
-        with self._lock:
+        with self._cond:
             return self._version
 
     def publish(self, tree: Any) -> int:
         """Store `tree` as the new latest snapshot; returns its version."""
-        with self._lock:
+        with self._cond:
             self._version += 1
             self._tree = tree
+            self._cond.notify_all()
             return self._version
 
     def latest(self) -> tuple[int, Any]:
         """(version, tree) of the newest published snapshot."""
-        with self._lock:
+        with self._cond:
             if self._version < 0:
                 raise RuntimeError("no weights published yet")
+            return self._version, self._tree
+
+    def wait_for_version(self, min_version: int = 0,
+                         timeout: Optional[float] = None,
+                         stop: Optional[threading.Event] = None,
+                         ) -> tuple[int, Any]:
+        """Block until a version >= `min_version` is published, then return
+        `latest()`. A rollout worker that joins the fleet BEFORE the trainer
+        publishes snapshot 0 (multi-host workers boot concurrently with the
+        trainer; in-process ones can race a slow `_policy_snapshot` copy)
+        must wait here instead of crash-looping `latest()`'s RuntimeError
+        through its consecutive-failure budget into quarantine.
+
+        `stop` (optional Event) aborts the wait with a TimeoutError when
+        set — a worker being shut down must not ride out a long timeout.
+        Raises TimeoutError after `timeout` seconds (None = wait forever).
+        """
+        deadline = (
+            None if timeout is None else threading.TIMEOUT_MAX
+            if timeout < 0 else timeout
+        )
+        with self._cond:
+            waited = 0.0
+            while self._version < min_version:
+                if stop is not None and stop.is_set():
+                    raise TimeoutError(
+                        f"stopped while waiting for weight version "
+                        f">= {min_version}"
+                    )
+                # slice the wait so `stop` is polled even with timeout=None
+                slice_s = 0.1 if deadline is None \
+                    else min(0.1, max(0.0, deadline - waited))
+                self._cond.wait(timeout=slice_s)
+                waited += slice_s
+                if deadline is not None and waited >= deadline \
+                        and self._version < min_version:
+                    raise TimeoutError(
+                        f"no weight version >= {min_version} published "
+                        f"after {timeout}s (latest: {self._version})"
+                    )
             return self._version, self._tree

@@ -166,6 +166,48 @@ def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     return NamedSharding(mesh, P(("data", "fsdp"), *([None] * (ndim - 1))))
 
 
+def split_worker_groups(devices, n_workers: int):
+    """Partition a rollout device group into `n_workers` equal per-worker
+    sub-groups (the fleet's per-worker generation meshes,
+    `RLConfig.rollout_workers > 1` + `rollout_devices > 0`).
+
+    Groups are contiguous in id order. Device ids are slice-major on TPU
+    pods (slice 0's chips number before slice 1's), so when the per-worker
+    size divides the slice size each worker's collectives stay inside a
+    slice (ICI); a per-worker group that straddles a slice boundary is
+    warned — its own collectives would ride DCN every decode step. Workers
+    joining beyond the initial cohort reuse the groups round-robin (worker
+    id mod n_groups), so elastic membership never re-partitions silicon
+    mid-run."""
+    if n_workers < 1:
+        raise ValueError(f"n_workers={n_workers} must be >= 1")
+    if len(devices) % n_workers != 0:
+        raise ValueError(
+            f"rollout_devices={len(devices)} not divisible by "
+            f"rollout_workers={n_workers} — every worker needs an "
+            "identically-shaped generation mesh (one compiled executable "
+            "serves the whole fleet)"
+        )
+    per = len(devices) // n_workers
+    ordered = sorted(devices, key=lambda d: d.id)
+    groups = [ordered[i * per:(i + 1) * per] for i in range(n_workers)]
+    if all(hasattr(d, "slice_index") for d in devices):
+        import warnings
+
+        for i, g in enumerate(groups):
+            slices = {d.slice_index for d in g}
+            if len(slices) > 1:
+                warnings.warn(
+                    f"split_worker_groups: worker {i}'s device group spans "
+                    f"slices {sorted(slices)} — its generation collectives "
+                    "ride DCN every decode step. Pick rollout_workers so "
+                    "the per-worker size divides the slice size.",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+    return groups
+
+
 def split_rollout_devices(devices, k: int):
     """(train_devices, rollout_devices): reserve `k` devices for generation.
 

@@ -19,6 +19,19 @@ Injection points (wired in trainer/checkpoint/orchestrator dispatch):
                      poisons the observed loss/grad-norm instead of raising,
                      exercising the sentinel exactly like a real NaN step
 
+Worker-scoped points (wired in the rollout fleet's worker loop,
+orchestrator/fleet.py — each selectable by worker id via `worker=I`):
+
+    worker.crash         worker thread dies (fatal — the lease is revoked
+                         and reassigned; heartbeat/membership notice it)
+    worker.hang          worker stalls holding its lease until the lease
+                         deadline revokes it (default action "hang")
+    worker.slow          worker sleeps `delay` seconds before dispatching —
+                         the straggler/speculative-re-dispatch path
+                         (default action "delay")
+    worker.fetch_weights worker's weight-store fetch raises (recoverable —
+                         counts toward the consecutive-failure quarantine)
+
 Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
 by ";" or whitespace):
 
@@ -30,13 +43,25 @@ by ";" or whitespace):
     seed=S     PRNG seed for prob (default 0 — always deterministic)
     count=C    cap total fires (default: 1 for `at`, unbounded otherwise)
     action=A   "raise" (default) raises InjectedFault; "nan" returns "nan"
-               from fire() for the caller to poison its observed value
+               from fire() for the caller to poison its observed value;
+               "hang"/"delay" return themselves for the fleet worker loop
+               to stall on (worker.* points default to the matching action)
+    worker=I   only fire for calls tagged with this worker id
+               (`fire(point, worker=I)`); the call counter then counts
+               THAT worker's calls — `at=1,worker=0` is worker 0's first
+               dispatch, deterministic even though fleet workers race.
+               Without `worker=`, calls from all workers share one counter
+               in arrival order (nondeterministic across threads — fine
+               for `every=1`, not for `at=N` assertions).
+    delay=S    seconds for action "delay" (default 1.0)
 
 Examples:
 
     NANORLHF_FAULT="ckpt.save:at=1"                 first save write fails once
     NANORLHF_FAULT="rollout.produce:every=1"        every produce attempt dies
     NANORLHF_FAULT="update.step:at=2,action=nan"    2nd update observes NaN
+    NANORLHF_FAULT="worker.crash:at=1,worker=0"     worker 0 dies on 1st lease
+    NANORLHF_FAULT="worker.slow:every=2,worker=1,delay=0.5"
 """
 
 from __future__ import annotations
@@ -56,9 +81,18 @@ INJECTION_POINTS = frozenset({
     "rollout.produce",
     "reward.exec",
     "update.step",
+    # worker-scoped fleet sites (orchestrator/fleet.py worker loop)
+    "worker.crash",
+    "worker.hang",
+    "worker.slow",
+    "worker.fetch_weights",
 })
 
-ACTIONS = ("raise", "nan")
+ACTIONS = ("raise", "nan", "hang", "delay")
+
+# a bare `worker.hang:at=1` should hang, not raise — the point name IS the
+# intended behavior; an explicit action= still overrides
+_DEFAULT_ACTIONS = {"worker.hang": "hang", "worker.slow": "delay"}
 
 
 class InjectedFault(RuntimeError):
@@ -79,7 +113,9 @@ class FaultSchedule:
     prob: Optional[float] = None
     seed: int = 0
     count: Optional[int] = None   # max fires; None = unbounded
-    action: str = "raise"
+    action: Optional[str] = None  # None -> point default ("raise" mostly)
+    worker: Optional[int] = None  # only match calls tagged with this worker
+    delay: float = 1.0            # seconds, action="delay"
     # runtime state
     calls: int = 0
     fires: int = 0
@@ -90,6 +126,8 @@ class FaultSchedule:
                 f"unknown injection point {self.point!r}; known: "
                 f"{sorted(INJECTION_POINTS)}"
             )
+        if self.action is None:
+            self.action = _DEFAULT_ACTIONS.get(self.point, "raise")
         if self.action not in ACTIONS:
             raise ValueError(f"action={self.action!r}: {' | '.join(ACTIONS)}")
         if sum(x is not None for x in (self.at, self.every, self.prob)) != 1:
@@ -127,9 +165,9 @@ def parse_fault_spec(spec: str) -> list[FaultSchedule]:
             if "=" not in kv:
                 raise ValueError(f"fault entry {entry!r}: bad clause {kv!r}")
             k, _, v = kv.partition("=")
-            if k in ("at", "every", "seed", "count"):
+            if k in ("at", "every", "seed", "count", "worker"):
                 kwargs[k] = int(v)
-            elif k == "prob":
+            elif k in ("prob", "delay"):
                 kwargs[k] = float(v)
             elif k == "action":
                 kwargs[k] = v
@@ -142,9 +180,12 @@ def parse_fault_spec(spec: str) -> list[FaultSchedule]:
 class FaultInjector:
     """Thread-safe registry of armed fault schedules.
 
-    `fire(point)` advances every schedule armed on `point`; when one
-    triggers with action "raise" it raises InjectedFault, with action "nan"
-    it returns "nan" for the caller to poison its observation. Returns None
+    `fire(point, worker=...)` advances every schedule armed on `point`
+    (schedules carrying a `worker` selector only when the tag matches);
+    when one triggers with action "raise" it raises InjectedFault, with
+    action "nan" it returns "nan" for the caller to poison its observation,
+    with "hang" it returns "hang", and with "delay" it returns
+    "delay:<seconds>" for the fleet worker loop to stall on. Returns None
     when nothing fires — the disarmed fast path is one dict lookup, so
     production code leaves the calls in unconditionally."""
 
@@ -165,15 +206,22 @@ class FaultInjector:
     def armed(self) -> bool:
         return bool(self._by_point)
 
-    def fire(self, point: str) -> Optional[str]:
+    def fire(self, point: str, worker: Optional[int] = None) -> Optional[str]:
         schedules = self._by_point.get(point)
         if not schedules:
             return None
         with self._lock:
             for s in schedules:
+                if s.worker is not None and s.worker != worker:
+                    continue  # call not tagged for this schedule's worker
                 if s.should_fire():
                     if s.action == "raise":
-                        raise InjectedFault(point, detail=f"call {s.calls}")
+                        detail = f"call {s.calls}" + (
+                            f" worker {worker}" if worker is not None else ""
+                        )
+                        raise InjectedFault(point, detail=detail)
+                    if s.action == "delay":
+                        return f"delay:{s.delay}"
                     return s.action
         return None
 

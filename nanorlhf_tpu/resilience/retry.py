@@ -4,6 +4,7 @@ exception allowlist — supervision layers above decide what failure means."""
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Optional, Type
 
@@ -16,9 +17,12 @@ def retry_with_backoff(
     retry_on: tuple[Type[BaseException], ...] = (Exception,),
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    jitter: float = 0.0,
+    rng: Optional[random.Random] = None,
 ):
     """Call `fn()` up to `attempts` times; sleep base·2^k (capped) between
-    tries. `on_retry(attempt_index, exc)` observes each failure that will be
+    tries, spread by ±`jitter` fraction (see `backoff_delay` for why).
+    `on_retry(attempt_index, exc)` observes each failure that will be
     retried — the hook where callers count retries into metrics. The final
     failure propagates unchanged."""
     if attempts < 1:
@@ -31,9 +35,24 @@ def retry_with_backoff(
                 raise
             if on_retry is not None:
                 on_retry(attempt, e)
-            sleep(min(backoff_max, backoff_base * (2 ** attempt)))
+            sleep(backoff_delay(attempt, backoff_base, backoff_max,
+                                jitter=jitter, rng=rng))
 
 
-def backoff_delay(attempt: int, base: float, cap: float) -> float:
-    """Exponential backoff schedule shared by the producer watchdog."""
-    return min(cap, base * (2 ** max(0, attempt)))
+def backoff_delay(attempt: int, base: float, cap: float,
+                  jitter: float = 0.0,
+                  rng: Optional[random.Random] = None) -> float:
+    """Exponential backoff schedule shared by the producer watchdog and the
+    fleet's worker-quarantine re-admission.
+
+    `jitter` spreads the delay uniformly over ±jitter·delay: N workers (or N
+    restarted producers) that failed on the same cause at the same moment
+    would otherwise all sleep EXACTLY base·2^k and stampede the weight
+    store / checkpoint filesystem in lockstep on every retry wave. Callers
+    that need determinism pass a seeded `random.Random`; the default draws
+    from the module PRNG (jitter=0.0, the default, stays bit-stable)."""
+    delay = min(cap, base * (2 ** max(0, attempt)))
+    if jitter > 0.0 and delay > 0.0:
+        draw = (rng.random() if rng is not None else random.random())
+        delay *= 1.0 + jitter * (2.0 * draw - 1.0)
+    return min(cap, delay)

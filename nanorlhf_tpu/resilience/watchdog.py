@@ -20,6 +20,7 @@ degradation. `restarts_total` counts every restart for the
 from __future__ import annotations
 
 import dataclasses
+import random
 
 from nanorlhf_tpu.resilience.retry import backoff_delay
 
@@ -29,6 +30,11 @@ class WatchdogConfig:
     restart_budget: int = 2       # consecutive restarts before degrading
     backoff_base: float = 0.5     # seconds; doubles per consecutive failure
     backoff_max: float = 30.0
+    # ±fraction spread on each restart delay (resilience/retry.backoff_delay):
+    # several supervised producers/fleets restarted off the same failure
+    # would otherwise retry against the weight store in lockstep. 0 keeps
+    # the schedule exact (policy unit tests pin the 2× doubling).
+    backoff_jitter: float = 0.0
     degrade_to_sync: bool = True  # past budget: sync fallback vs re-raise
     # (the producer liveness poll interval lives on the orchestrator —
     # RLConfig.producer_heartbeat — not here: the watchdog only decides
@@ -42,8 +48,10 @@ class ProducerWatchdog:
     DEGRADE = "degrade"
     RAISE = "raise"
 
-    def __init__(self, config: WatchdogConfig | None = None):
+    def __init__(self, config: WatchdogConfig | None = None,
+                 seed: int = 0):
         self.cfg = config or WatchdogConfig()
+        self._rng = random.Random(seed)  # deterministic jitter draws
         self.consecutive_failures = 0
         self.restarts_total = 0
         self.degraded = False
@@ -61,6 +69,7 @@ class ProducerWatchdog:
         return self.RESTART, backoff_delay(
             self.consecutive_failures - 1,
             self.cfg.backoff_base, self.cfg.backoff_max,
+            jitter=self.cfg.backoff_jitter, rng=self._rng,
         )
 
     def on_success(self) -> None:

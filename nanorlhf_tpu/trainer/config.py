@@ -169,6 +169,33 @@ class RLConfig:
     # variance at a small bias toward under-weighting fresh-policy-favored
     # tokens.
     offpolicy_is_truncation: float = 2.0
+    # ---- elastic rollout fleet (orchestrator/fleet.py, docs/FLEET.md).
+    # >1 generalizes the orchestrator's single producer thread into N
+    # independent, preemptible rollout workers behind a FleetCoordinator:
+    # leased rollout-index ranges with EWMA-derived deadlines, per-worker
+    # heartbeat liveness, lease revocation + reassignment on worker loss
+    # (same cached prompt batches + index-keyed PRNG — staleness-0 token
+    # streams are bit-identical under reassignment, test-pinned),
+    # consecutive-failure quarantine with jittered exponential backoff,
+    # straggler speculative re-dispatch, and elastic join/leave; losing the
+    # last worker falls through the producer watchdog to the synchronous
+    # degraded mode. Requires rollout_orchestrator=True. Useful pipelining
+    # needs max_staleness >= rollout_workers (the staleness gate bounds how
+    # many indices can be in flight); pairs with rollout_devices>0, whose
+    # device group is then split into per-worker meshes
+    # (parallel/mesh.split_worker_groups). 1 = the single producer thread.
+    rollout_workers: int = 1
+    fleet_lease_size: int = 1          # rollout indices per lease
+    fleet_failure_budget: int = 2      # consecutive failures → quarantine
+    fleet_quarantine_base: float = 0.5  # re-admission backoff base · 2^k s
+    fleet_quarantine_max: float = 30.0
+    # ±fraction jitter on quarantine backoff — N workers failing on one
+    # cause must not stampede the weight store in lockstep retry waves
+    fleet_backoff_jitter: float = 0.25
+    fleet_straggler_factor: float = 4.0  # lease deadline = factor·ewma·len
+    # pre-EWMA lease deadline AND heartbeat-silence timeout (seconds): must
+    # comfortably exceed a cold-cache first compile
+    fleet_initial_deadline: float = 600.0
 
     # ---- optimization ----
     learning_rate: float = 6e-6
@@ -286,6 +313,10 @@ class RLConfig:
     producer_restart_budget: int = 2
     producer_backoff_base: float = 0.5
     producer_backoff_max: float = 30.0
+    # ±fraction jitter on watchdog restart backoff (resilience/retry.py):
+    # several supervised pipelines restarted off one shared cause (a weight
+    # store hiccup, a flaky filesystem) must not retry in lockstep
+    producer_backoff_jitter: float = 0.1
     producer_heartbeat: float = 30.0    # liveness poll interval in get()
     degrade_to_sync: bool = True
     # checkpoint I/O hardening: save/restore attempts retried with backoff

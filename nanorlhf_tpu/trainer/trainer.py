@@ -290,24 +290,41 @@ class RLTrainer:
         # its own device group + mesh; training spans the rest. The trainer
         # owns both meshes — an externally built mesh can't be split safely.
         self.rollout_mesh = None
-        self._disagg_base = None  # rollout-mesh copy of the frozen LoRA base
+        # per-generation-mesh copies of the frozen LoRA base, keyed by mesh
+        # identity: the single disaggregated mesh AND each fleet worker's
+        # group get their own once-resharded base (see _rollout_params)
+        self._disagg_base: dict = {}
+        # per-worker generation meshes (rollout fleet × disaggregation):
+        # None = every worker generates on the shared rollout/train mesh
+        self.worker_meshes = None
         if config.rollout_devices > 0:
             if mesh is not None:
                 raise ValueError(
                     "rollout_devices>0 builds its own train+rollout meshes; "
                     "pass mesh=None"
                 )
-            from nanorlhf_tpu.parallel.mesh import split_rollout_devices
+            from nanorlhf_tpu.parallel.mesh import (
+                split_rollout_devices,
+                split_worker_groups,
+            )
 
             train_dev, roll_dev = split_rollout_devices(
                 jax.devices(), config.rollout_devices
             )
             self.mesh = make_mesh(config.mesh, devices=train_dev)
-            self.rollout_mesh = make_mesh(
-                config.rollout_mesh if config.rollout_mesh is not None
-                else MeshConfig(),
-                devices=roll_dev,
-            )
+            rm_cfg = (config.rollout_mesh if config.rollout_mesh is not None
+                      else MeshConfig())
+            # the whole-group mesh stays: the synchronous/degraded fallback
+            # generates on all reserved devices even when the fleet split
+            # them per worker
+            self.rollout_mesh = make_mesh(rm_cfg, devices=roll_dev)
+            if config.rollout_workers > 1:
+                self.worker_meshes = [
+                    make_mesh(rm_cfg, devices=group)
+                    for group in split_worker_groups(
+                        roll_dev, config.rollout_workers
+                    )
+                ]
         else:
             self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         # Pallas-kernel SPMD hints (core/config.py spmd_mesh): on a mesh
@@ -356,6 +373,14 @@ class RLTrainer:
                 raise ValueError(
                     f"staleness_policy={config.staleness_policy!r}: wait|drop"
                 )
+        if config.rollout_workers < 1:
+            raise ValueError(f"rollout_workers={config.rollout_workers}")
+        if config.rollout_workers > 1 and not config.rollout_orchestrator:
+            raise ValueError(
+                "rollout_workers > 1 is the fleet generalization of the "
+                "async pipeline — it requires rollout_orchestrator=True "
+                "(docs/FLEET.md)"
+            )
         if config.offpolicy_correction not in ("truncated_is", "none"):
             raise ValueError(
                 f"offpolicy_correction={config.offpolicy_correction!r}"
@@ -489,8 +514,14 @@ class RLTrainer:
             restart_budget=config.producer_restart_budget,
             backoff_base=config.producer_backoff_base,
             backoff_max=config.producer_backoff_max,
+            backoff_jitter=config.producer_backoff_jitter,
             degrade_to_sync=config.degrade_to_sync,
-        ))
+            # the jitter exists to DE-correlate replicas that share a
+            # training seed (SPMD determinism forces that), so the draw
+            # seed must mix in per-process identity or every replica
+            # computes the same "random" backoff and stampedes anyway
+        ), seed=(config.seed << 20) ^ (jax.process_index() << 10)
+            ^ os.getpid())
         self._preemption = (
             PreemptionGuard() if config.graceful_preemption else null_guard()
         )
@@ -592,7 +623,7 @@ class RLTrainer:
         q = quantize_layers(src["layers"])
         self._quant_layers = shard_params({"layers": q}, self.mesh)["layers"]
 
-    def _rollout_params(self, tree: Optional[dict] = None):
+    def _rollout_params(self, tree: Optional[dict] = None, mesh=None):
         """The param tree generation samples from: exact everywhere, except
         int8 base projections when rollout_quant is on (LoRA/embed/norm are
         always the live exact arrays — see core/quant.py). With a dedicated
@@ -601,7 +632,8 @@ class RLTrainer:
         that crosses the train/rollout device groups). `tree` overrides the
         live self.params source — the orchestrator's producer thread passes
         a PUBLISHED snapshot so generation never races the jitted update's
-        buffer donation."""
+        buffer donation. `mesh` overrides the destination mesh — a fleet
+        worker passes its own device group's mesh (docs/FLEET.md)."""
         src = self.params if tree is None else tree
         if self._quant_layers is None:
             tree = src
@@ -611,21 +643,23 @@ class RLTrainer:
             from nanorlhf_tpu.core.quant import rollout_view
 
             tree = rollout_view(src, self._quant_layers)
-        if self.rollout_mesh is not None:
+        mesh = mesh if mesh is not None else self.rollout_mesh
+        if mesh is not None:
             if self.cfg.use_lora:
-                # LoRA freezes the base: re-shard it onto the rollout mesh
-                # ONCE and reuse; per dispatch only the live adapter subtree
-                # (MBs, not the GBs of base projections) crosses the
-                # train/rollout device groups
-                if self._disagg_base is None:
-                    self._disagg_base = shard_params(
+                # LoRA freezes the base: re-shard it onto each generation
+                # mesh ONCE and reuse; per dispatch only the live adapter
+                # subtree (MBs, not the GBs of base projections) crosses
+                # the train/rollout device groups
+                base = self._disagg_base.get(id(mesh))
+                if base is None:
+                    base = self._disagg_base[id(mesh)] = shard_params(
                         {k: v for k, v in tree.items() if k != "lora"},
-                        self.rollout_mesh,
+                        mesh,
                     )
-                live = shard_params({"lora": tree["lora"]}, self.rollout_mesh)
-                tree = {**self._disagg_base, **live}
+                live = shard_params({"lora": tree["lora"]}, mesh)
+                tree = {**base, **live}
             else:
-                tree = shard_params(tree, self.rollout_mesh)
+                tree = shard_params(tree, mesh)
         return tree
 
     # ------------------------------------------------------------------ #
@@ -645,34 +679,89 @@ class RLTrainer:
         )
 
     def _ensure_orchestrator(self, body: Callable):
-        """Create (once) the producer-thread pipeline. The orchestrator
-        outlives train() calls — the pipeline stays warm across repeated
-        train(num_updates=1) invocations (how bench measures) — and is torn
-        down by close() or resume_from_checkpoint()."""
+        """Create (once) the rollout pipeline — the single producer thread
+        (rollout_workers == 1) or the N-worker fleet (docs/FLEET.md); both
+        share the consumer surface, so everything downstream (watchdog,
+        sentinel, checkpoints) is mode-blind. The pipeline outlives train()
+        calls — it stays warm across repeated train(num_updates=1)
+        invocations (how bench measures) — and is torn down by close() or
+        resume_from_checkpoint()."""
         if self._orchestrator is None:
-            from nanorlhf_tpu.orchestrator import RolloutOrchestrator
+            cfg = self.cfg
+            if cfg.rollout_workers > 1:
+                from nanorlhf_tpu.orchestrator import FleetOrchestrator
+                from nanorlhf_tpu.orchestrator.fleet import FleetConfig
 
-            def dispatch(index: int, tree: dict) -> dict:
-                # the producer is the SOLE consumer of the data iterator,
-                # and keys come from the stateless index-keyed stream — the
-                # same (data, PRNG) cursors the synchronous trainer uses,
-                # so checkpoint/resume fast-forwards reproduce the streams
-                queries = np.asarray(next(self._iter))
-                key = jax.random.fold_in(self._rollout_base, index)
-                return body(queries, key, tree)
+                def batch_fn():
+                    # the COORDINATOR is the sole consumer of the data
+                    # iterator (under its lock, in strict index order) and
+                    # caches each lease's batches — reassignment replays
+                    # the same batch without re-burning the cursor
+                    return np.asarray(next(self._iter))
 
-            self._orchestrator = RolloutOrchestrator(
-                dispatch_fn=dispatch,
-                initial_params=self._policy_snapshot(),
-                start_index=self.state["rollouts"],
-                max_staleness=self.cfg.max_staleness,
-                policy=self.cfg.staleness_policy,
-                meter=self._rollout_meter,
-                restore=self._orch_restore_state,
-                heartbeat=self.cfg.producer_heartbeat,
-                faults=self.faults,
-                tracer=self.tracer,
-            )
+                def fleet_dispatch(index: int, queries, tree: dict,
+                                   worker_id: int) -> dict:
+                    # the same stateless index-keyed PRNG stream as every
+                    # other mode: WHICH worker generates a sample can never
+                    # change WHAT is generated (staleness-0 bit parity)
+                    key = jax.random.fold_in(self._rollout_base, index)
+                    gen_mesh = None
+                    if self.worker_meshes:
+                        gen_mesh = self.worker_meshes[
+                            worker_id % len(self.worker_meshes)
+                        ]
+                    return body(queries, key, tree, gen_mesh)
+
+                self._orchestrator = FleetOrchestrator(
+                    dispatch_fn=fleet_dispatch,
+                    batch_fn=batch_fn,
+                    initial_params=self._policy_snapshot(),
+                    n_workers=cfg.rollout_workers,
+                    start_index=self.state["rollouts"],
+                    max_staleness=cfg.max_staleness,
+                    policy=cfg.staleness_policy,
+                    meter=self._rollout_meter,
+                    restore=self._orch_restore_state,
+                    heartbeat=cfg.producer_heartbeat,
+                    faults=self.faults,
+                    tracer=self.tracer,
+                    fleet=FleetConfig(
+                        lease_size=cfg.fleet_lease_size,
+                        failure_budget=cfg.fleet_failure_budget,
+                        quarantine_base=cfg.fleet_quarantine_base,
+                        quarantine_max=cfg.fleet_quarantine_max,
+                        backoff_jitter=cfg.fleet_backoff_jitter,
+                        straggler_factor=cfg.fleet_straggler_factor,
+                        initial_deadline_s=cfg.fleet_initial_deadline,
+                        worker_timeout_s=cfg.fleet_initial_deadline,
+                        seed=cfg.seed,
+                    ),
+                )
+            else:
+                from nanorlhf_tpu.orchestrator import RolloutOrchestrator
+
+                def dispatch(index: int, tree: dict) -> dict:
+                    # the producer is the SOLE consumer of the data
+                    # iterator, and keys come from the stateless
+                    # index-keyed stream — the same (data, PRNG) cursors
+                    # the synchronous trainer uses, so checkpoint/resume
+                    # fast-forwards reproduce the streams
+                    queries = np.asarray(next(self._iter))
+                    key = jax.random.fold_in(self._rollout_base, index)
+                    return body(queries, key, tree)
+
+                self._orchestrator = RolloutOrchestrator(
+                    dispatch_fn=dispatch,
+                    initial_params=self._policy_snapshot(),
+                    start_index=self.state["rollouts"],
+                    max_staleness=cfg.max_staleness,
+                    policy=cfg.staleness_policy,
+                    meter=self._rollout_meter,
+                    restore=self._orch_restore_state,
+                    heartbeat=cfg.producer_heartbeat,
+                    faults=self.faults,
+                    tracer=self.tracer,
+                )
             self._orch_restore_state = None
         return self._orchestrator
 
@@ -1271,10 +1360,12 @@ class RLTrainer:
         ctx_menu = shape_menu(self.dataset.input_ids.shape[1], min_value=16) \
             if hasattr(self.dataset, "input_ids") else None
 
-        def rollout_body(queries, gen_key, gen_tree=None):
+        def rollout_body(queries, gen_key, gen_tree=None, gen_mesh=None):
             """DISPATCH one rollout (async — nothing blocks until fetched).
             `gen_tree` (orchestrated mode) is a published weight-store
-            snapshot; None samples from the live params."""
+            snapshot; None samples from the live params. `gen_mesh` (fleet
+            × disaggregation) is the calling worker's own device group;
+            None generates on the shared rollout/train mesh."""
             if ctx_menu is not None:
                 # r1's de-padding applied to every algorithm: batches of short
                 # prompts roll out / score at a menu-rounded context (warm jit
@@ -1283,11 +1374,13 @@ class RLTrainer:
             if self._sp_on():
                 self._sp_check_widths(queries.shape[1])
             bs = batch_sharding(
-                self.mesh if self.rollout_mesh is None else self.rollout_mesh
+                gen_mesh if gen_mesh is not None
+                else self.mesh if self.rollout_mesh is None
+                else self.rollout_mesh
             )
             queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
-            gen_params = self._rollout_params(gen_tree)
+            gen_params = self._rollout_params(gen_tree, mesh=gen_mesh)
             # speculative decode (rollout_spec_k > 0) appends its acceptance
             # counters here — device scalars fetched at metrics time, after
             # the tokens already forced a sync. The tracer hands the spec
@@ -1373,10 +1466,14 @@ class RLTrainer:
                         # flight recorder first: the blackbox must capture
                         # what every thread was doing when the producer
                         # died, before the restart machinery mutates state
+                        extra = {"error": repr(e.__cause__ or e)}
+                        if hasattr(orch, "fleet_stats"):
+                            # fleet post-mortem: membership/lease/quarantine
+                            # counters at the moment of exhaustion
+                            extra["fleet"] = orch.fleet_stats()
                         self.tracer.dump_blackbox(
                             self._telemetry_dir, self.state["global_step"],
-                            "producer_failure",
-                            extra={"error": repr(e.__cause__ or e)},
+                            "producer_failure", extra=extra,
                         )
                         decision, delay = self.watchdog.on_failure()
                         if decision == ProducerWatchdog.RESTART:
@@ -1772,6 +1869,15 @@ class RLTrainer:
                 metrics.update(staleness_histogram_metrics(
                     ostats["staleness_counts"]
                 ))
+                if hasattr(orch, "fleet_stats"):
+                    # fleet/* series (docs/METRICS.md): membership gauges +
+                    # cumulative lease/reassignment/quarantine counters
+                    # (counters survive restart/degrade/resume via the
+                    # coordinator journal, like the queue's)
+                    metrics.update({
+                        f"fleet/{k}": v
+                        for k, v in orch.fleet_stats().items()
+                    })
             if self._use_is:
                 metrics["offpolicy/is_weight_mean_new"] = agg.get(
                     "is_weight_mean", 1.0
