@@ -39,6 +39,10 @@ the step wall, docs/RESILIENCE.md),
 BENCH_TELEMETRY (1: also measure with the span tracer enabled and report
 detail.telemetry.telemetry_overhead_frac — the observability acceptance
 gate is < 1% of step wall, docs/OBSERVABILITY.md),
+BENCH_HEALTH (1: also measure with the run-health plane disabled and report
+detail.health.health_overhead_frac — the streaming-aggregator + rule-eval
+cost of the default-on health monitor; acceptance < 1% of step wall,
+docs/OBSERVABILITY.md §5),
 BENCH_FLEET_WORKERS (0: >1 also measures the elastic rollout fleet at that
 worker count against the single-producer pipeline at the SAME staleness
 and reports detail.fleet.coordinator_overhead_frac — the lease/reorder
@@ -619,7 +623,7 @@ def run_bench(jax, init_error):
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
-                telemetry=False, spec_k=None, workers=1):
+                telemetry=False, spec_k=None, workers=1, health=True):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -654,6 +658,7 @@ def run_bench(jax, init_error):
             max_staleness=staleness,
             sentinel=sentinel,
             telemetry=telemetry,
+            health=health,
             kv_cache_quant=kv_quant,
             rollout_spec_k=spec_k,
             gradient_checkpointing=True,
@@ -875,6 +880,36 @@ def run_bench(jax, init_error):
         except Exception as e:
             telemetry_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # health-plane overhead A/B (docs/OBSERVABILITY.md §5 acceptance: the
+    # default-ON streaming aggregators + rule evaluation cost < 1% of step
+    # wall): the chosen config already ran with health on, so re-measure it
+    # with the monitor disabled and report on-vs-off. Same budget gate as
+    # the telemetry A/B.
+    health_detail = None
+    if (os.environ.get("BENCH_HEALTH", "1") == "1"
+            and budget - (time.time() - _T0) > 0.9 * t_baseline):
+        try:
+            health_off = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"],
+                capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
+                health=False,
+            )
+            off_sec = health_off["sec_per_update_steady"]
+            health_detail = {
+                "off_sec_per_update": off_sec,
+                "on_sec_per_update": chosen["sec_per_update_steady"],
+                "health_overhead_frac": round(
+                    (chosen["sec_per_update_steady"] - off_sec)
+                    / max(off_sec, 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            health_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # fleet-coordinator overhead A/B (docs/FLEET.md acceptance: the lease /
     # reorder-buffer / liveness machinery costs < 2% of step wall): measure
     # the single-producer pipeline and the N-worker fleet at the SAME
@@ -1025,6 +1060,12 @@ def run_bench(jax, init_error):
         "mfu": round(mfu, 4),
         "peak_flops_per_chip": peak,
         "peak_flops_known": peak_known,
+        # the peak-FLOPs table fell back to a nominal constant for this
+        # chip: the mfu number above is a placeholder ratio, not a real
+        # utilization figure — don't read it bare
+        **({} if peak_known else
+           {"mfu_note": "untrusted: peak FLOPs unknown for this chip "
+                        "(nominal constant used)"}),
         "phase_split_s_per_update": chosen["phase_split_s_per_update"],
         **pallas,
     }
@@ -1034,6 +1075,8 @@ def run_bench(jax, init_error):
         detail["sentinel"] = sentinel_detail
     if telemetry_detail is not None:
         detail["telemetry"] = telemetry_detail
+    if health_detail is not None:
+        detail["health"] = health_detail
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
     if short_detail is not None:

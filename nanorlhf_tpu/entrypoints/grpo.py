@@ -12,7 +12,8 @@ def build_config(sequence_parallel: int = 1,
                  rollout_staleness: int | None = None,
                  rollout_devices: int = 0,
                  rollout_workers: int = 1,
-                 rollout_spec_k: int = 0) -> RLConfig:
+                 rollout_spec_k: int = 0,
+                 status_port: int = 0) -> RLConfig:
     """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
     update through ring attention with the sequence dim sharded over an sp
     mesh axis (response_length must divide by it).
@@ -33,7 +34,12 @@ def build_config(sequence_parallel: int = 1,
 
     `rollout_spec_k > 0` turns on draft-free speculative rollout decode
     (sampler/speculative.py, distribution-exact); composes with every knob
-    above except rollout_compaction_segments."""
+    above except rollout_compaction_segments.
+
+    `status_port != 0` serves the live run-health endpoints /metrics ·
+    /healthz · /statusz on that port (-1 = ephemeral; docs/OBSERVABILITY.md
+    §5). Health scoring itself is on regardless — this only exposes it
+    over HTTP."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-v1",
@@ -86,6 +92,7 @@ def build_config(sequence_parallel: int = 1,
     if rollout_devices > 0:
         cfg.rollout_devices = rollout_devices
     cfg.rollout_spec_k = rollout_spec_k
+    cfg.status_port = status_port
     return cfg
 
 

@@ -558,6 +558,45 @@ class FleetCoordinator:
                 **{k: float(v) for k, v in self.counters.items()},
             }
 
+    def snapshot(self) -> dict:
+        """Structured membership + lease table for the status exporter's
+        /statusz (telemetry/exporter.py) — the human-readable companion to
+        the flat `stats()` gauges: who is in the fleet, who is quarantined
+        or lost, and which leases are in flight against what deadline."""
+        with self._cond:
+            now = self._clock()
+            return {
+                "workers": [
+                    {
+                        "worker_id": r.worker_id,
+                        "lost": r.lost,
+                        "quarantined": r.quarantined_until > now,
+                        "quarantined_for_s": max(
+                            0.0, round(r.quarantined_until - now, 3)
+                        ),
+                        "consecutive_failures": r.consecutive_failures,
+                        "quarantines": r.quarantines,
+                        "samples": r.samples,
+                        "ewma_s": round(r.ewma_s, 4),
+                        "heartbeat_age_s": round(now - r.last_heartbeat, 3),
+                    }
+                    for r in self._workers.values()
+                ],
+                "leases": [
+                    {
+                        "lease_id": l.lease_id,
+                        "worker_id": l.worker_id,
+                        "start": l.start,
+                        "batches": len(l),
+                        "age_s": round(now - l.issued_at, 3),
+                        "deadline_in_s": round(l.deadline - now, 3),
+                        "reassigned_from": l.reassigned_from,
+                    }
+                    for l in self._leases.values()
+                ],
+                "counters": dict(self.counters),
+            }
+
     def journal(self) -> dict:
         """JSON-able coordinator state for trainer_state.json. Granted-but-
         unemitted indices are informational (resume re-draws them from the
@@ -926,6 +965,14 @@ class FleetOrchestrator:
     def fleet_stats(self) -> dict:
         """fleet/* metric rows (docs/METRICS.md)."""
         return self.coordinator.stats()
+
+    def status_snapshot(self) -> dict:
+        """/statusz seam (telemetry/exporter.py): queue counters + the
+        fleet membership/lease table, JSON-able and safe from any thread."""
+        return {
+            "queue": {**self.stats(), "version": self.version},
+            "fleet": self.coordinator.snapshot(),
+        }
 
     def journal(self) -> dict:
         return {**self.queue.journal(), "fleet": self.coordinator.journal()}

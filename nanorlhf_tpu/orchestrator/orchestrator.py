@@ -352,6 +352,12 @@ class RolloutOrchestrator:
             "producer_gate_wait_s": self.queue.producer_gate_wait_s,
         }
 
+    def status_snapshot(self) -> dict:
+        """/statusz seam (telemetry/exporter.py): queue counters + policy
+        version, JSON-able and safe from any thread (single-producer
+        pipelines have no fleet table)."""
+        return {"queue": {**self.stats(), "version": self.version}}
+
     def journal(self) -> dict:
         """Checkpoint payload (trainer_state.json "orchestrator" key)."""
         return self.queue.journal()

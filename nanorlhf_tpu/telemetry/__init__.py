@@ -1,9 +1,23 @@
 """Telemetry subsystem (docs/OBSERVABILITY.md): cross-thread span tracing
 with a flight-recorder ring (tracer.py — Chrome trace-event JSON, Perfetto-
-loadable), and analytic MFU/throughput accounting with a jax.monitoring
-recompile counter (mfu.py). tracer.py is jax-free; mfu.py imports jax
-lazily — bench's jax-averse parent can load either by file path."""
+loadable), analytic MFU/throughput accounting with a jax.monitoring
+recompile counter (mfu.py), and the run-health plane — streaming anomaly
+detection over the metric stream (health.py) plus a live /metrics ·
+/healthz · /statusz HTTP exporter (exporter.py). tracer/health/exporter
+are jax-free; mfu.py imports jax lazily — bench's jax-averse parent can
+load any of them by file path."""
 
+from nanorlhf_tpu.telemetry.exporter import (
+    StatusExporter,
+    render_prometheus,
+    validate_prometheus_text,
+)
+from nanorlhf_tpu.telemetry.health import (
+    DEFAULT_RULES,
+    HealthConfig,
+    HealthMonitor,
+    HealthRule,
+)
 from nanorlhf_tpu.telemetry.mfu import (
     BACKEND_COMPILE_EVENT,
     CPU_PEAK_FLOPS,
@@ -23,13 +37,20 @@ from nanorlhf_tpu.telemetry.tracer import (
 __all__ = [
     "BACKEND_COMPILE_EVENT",
     "CPU_PEAK_FLOPS",
+    "DEFAULT_RULES",
+    "HealthConfig",
+    "HealthMonitor",
+    "HealthRule",
     "PEAK_FLOPS_PER_CHIP",
     "RecompileCounter",
     "SpanTracer",
+    "StatusExporter",
     "flops_param_count",
     "peak_flops_per_chip",
     "recompile_counter",
+    "render_prometheus",
     "update_flops",
+    "validate_prometheus_text",
     "validate_trace_events",
     "validate_trace_file",
 ]

@@ -358,6 +358,25 @@ class RLConfig:
     profile_num_steps: int = 1
     profile_dir: Optional[str] = None
     profile_trigger_file: Optional[str] = None
+    # run-health plane (telemetry/health.py + exporter.py,
+    # docs/OBSERVABILITY.md §5): every metric row folds into O(1)-memory
+    # streaming aggregates (fast/slow EWMA, P² quantile sketches, windowed
+    # counter rates) and a declarative rule set scores the run OK/WARN/CRIT.
+    # Health is on by default (bench's detail.health A/B holds its overhead
+    # under 1%); the HTTP exporter is off by default. status_port: 0 = off,
+    # -1 = ephemeral port (tests/CI), >0 = fixed port serving /metrics
+    # (Prometheus text), /healthz (200/503 from the verdict), /statusz
+    # (JSON run state incl. fleet membership + lease table).
+    health: bool = True
+    health_fast_alpha: float = 0.5        # tracks ~the last 2 rows
+    health_slow_alpha: float = 0.05       # the baseline fast is judged by
+    health_warmup_steps: int = 8          # min rows per metric before firing
+    health_window_s: float = 60.0         # rate-rule sliding window
+    health_max_events: int = 64           # transition ring for /statusz
+    health_blackbox_on_crit: bool = True  # flight-recorder dump, reason="health"
+    health_arm_sentinel: bool = False     # CRIT enables TrainingSentinel if off
+    status_port: int = 0
+    status_host: str = "127.0.0.1"
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1

@@ -13,6 +13,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import threading
 import time
 
 
@@ -38,6 +39,8 @@ class MetricsLogger:
         self.report_to = report_to
         self._fh = None
         self._tb = None
+        self._lock = threading.Lock()
+        self._latest: dict = {}
         if report_to in ("jsonl", "tensorboard"):
             os.makedirs(output_dir, exist_ok=True)
             self._fh = open(os.path.join(output_dir, "metrics.jsonl"), "a")
@@ -57,17 +60,31 @@ class MetricsLogger:
             atexit.register(self.close)
 
     def _emit(self, prefix: str, x: int, extra: dict, metrics: dict):
-        record = {"step": x, **extra, "time": time.time()}
+        # t_mono: perf_counter, PhaseTimer's clock discipline — rate windows
+        # built on these rows survive NTP steps (unlike "time")
+        record = {"step": x, **extra, "time": time.time(),
+                  "t_mono": time.perf_counter()}
         record.update({k: float(v) for k, v in metrics.items()})
         print(f"[{prefix} {x}] " + " ".join(
             f"{k}={record[k]:.4g}" for k in sorted(metrics)[:8]
         ))
+        with self._lock:
+            # fresh dict each emit, never mutated after publish: latest()
+            # readers on exporter threads see a consistent row
+            self._latest = record
         if self._fh:
             self._fh.write(json.dumps(record) + "\n")
             self._fh.flush()
         if self._tb:
             for k, v in metrics.items():
                 self._tb.add_scalar(k, float(v), x)
+
+    def latest(self) -> dict:
+        """Thread-safe copy of the most recent metrics record ({} before
+        the first emit) — the exporter scrapes this instead of re-reading
+        the JSONL tail."""
+        with self._lock:
+            return dict(self._latest)
 
     def log(self, step: int, episode: int, metrics: dict):
         self._emit("step", step, {"episode": episode}, metrics)

@@ -671,6 +671,11 @@ class SparseGRPOTrainer(RLTrainer):
             if self.accuracy_func is not None and cfg.eval_steps and \
                     self.state["global_step"] % cfg.eval_steps == 0:
                 metrics["eval_accuracy_new"] = float(self.accuracy_func(self))
+            # run-health plane: same routing as the dense loop — every row
+            # folds into the monitor and the health/* gauges ride along
+            metrics.update(
+                self.health.observe(self.state["global_step"], metrics)
+            )
             if self.state["global_step"] % cfg.logging_steps == 0:
                 self.logger.log(self.state["global_step"], self.state["episode"], metrics)
                 kept_decoded = [decoded[i * n + j] for i, j in enumerate(keep)]
@@ -743,5 +748,6 @@ class SparseGRPOTrainer(RLTrainer):
                          "resilience": {
                              "sentinel": self.sentinel.journal(),
                              "watchdog": self.watchdog.journal(),
-                         }},
+                         },
+                         "health": self.health.journal()},
         )

@@ -73,7 +73,8 @@ from nanorlhf_tpu.ops.masking import (
 from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
                                         shard_params)
 from nanorlhf_tpu.sampler import SamplingParams, generate
-from nanorlhf_tpu.telemetry import (SpanTracer, flops_param_count,
+from nanorlhf_tpu.telemetry import (HealthConfig, HealthMonitor, SpanTracer,
+                                    StatusExporter, flops_param_count,
                                     peak_flops_per_chip, recompile_counter,
                                     update_flops)
 from nanorlhf_tpu.trainer.checkpoint import CheckpointManager
@@ -558,6 +559,33 @@ class RLTrainer:
             tracer=self.tracer,
         )
         self.logger = MetricsLogger(config.output_dir, config.report_to)
+        # run-health plane (telemetry/health.py, docs/OBSERVABILITY.md §5):
+        # every metrics row folds through streaming aggregates + anomaly
+        # rules; CRIT dumps a reason="health" blackbox through the tracer
+        # (a no-op when telemetry is off) and optionally arms the sentinel.
+        self.health = HealthMonitor(
+            HealthConfig(
+                enabled=config.health,
+                fast_alpha=config.health_fast_alpha,
+                slow_alpha=config.health_slow_alpha,
+                warmup=config.health_warmup_steps,
+                window_s=config.health_window_s,
+                max_events=config.health_max_events,
+                blackbox_on_crit=config.health_blackbox_on_crit,
+            ),
+            tracer=self.tracer,
+            blackbox_fn=self._health_blackbox,
+            on_crit=self._on_health_crit,
+        )
+        # live status endpoints (telemetry/exporter.py): off unless
+        # cfg.status_port is set (-1 = ephemeral — tests/CI)
+        self.exporter = StatusExporter(
+            config.status_port,
+            host=config.status_host,
+            metrics_fn=self.logger.latest,
+            health=self.health,
+            statusz_fn=self._statusz,
+        )
         from nanorlhf_tpu.utils.profiling import PhaseTimer, ProfileWindow
 
         self.timer = PhaseTimer(tracer=self.tracer)
@@ -849,6 +877,10 @@ class RLTrainer:
         return {
             "perf/mfu": flops / max(step_wall_s, 1e-9)
             / (self._peak_flops * self._n_devices),
+            # 0.0 = the peak-FLOPs table fell back to a nominal constant
+            # (e.g. CPU 1e12) and perf/mfu above is not a trustworthy
+            # utilization number — consumers (bench, /statusz) flag it
+            "perf/peak_flops_known": 1.0 if self._peak_flops_known else 0.0,
             "perf/tokens_per_sec_step": all_tokens / max(step_wall_s, 1e-9),
             "perf/tokens_per_sec_update": train_tokens / max(update_s, 1e-9),
             "perf/tokens_per_sec_rollout": (decode_tokens + prefill_tokens)
@@ -860,6 +892,53 @@ class RLTrainer:
             "perf/recompile_seconds": self._recompiles.seconds,
             "telemetry/spans_dropped": float(self.tracer.dropped),
         }
+
+    # ------------------------------------------------------------------ #
+    # run-health plane (telemetry/health.py + exporter.py)
+    # ------------------------------------------------------------------ #
+
+    def _health_blackbox(self, step: int, extra: dict):
+        """CRIT hook: dump the flight-recorder ring with reason="health"
+        (no-op returning None when the tracer is disabled)."""
+        return self.tracer.dump_blackbox(
+            self._telemetry_dir, step, "health", extra=extra
+        )
+
+    def _on_health_crit(self, step: int, rules: list):
+        """Optional escalation: a CRIT verdict arms the TrainingSentinel
+        when it was configured off (cfg.health_arm_sentinel) — divergence
+        detected by the health plane turns on rollback protection for the
+        rest of the run."""
+        if self.cfg.health_arm_sentinel and not self.sentinel.cfg.enabled:
+            self.sentinel.cfg.enabled = True
+            print(f"[health] CRIT at step {step} ({', '.join(rules)}): "
+                  "arming training sentinel")
+
+    def _statusz(self) -> dict:
+        """JSON state for the exporter's /statusz (called on HTTP threads —
+        everything read here is either immutable after __init__ or behind
+        its own lock)."""
+        latest = self.logger.latest()
+        orch = self._orchestrator  # local ref: trainer may close it
+        out = {
+            "unix_time": time.time(),
+            "algo": self.cfg.algo.value,
+            "step": self.state.get("global_step", 0),
+            "episode": self.state.get("episode", 0),
+            "policy_version": (orch.version if orch is not None
+                               else self.state.get("global_step", 0)),
+            "devices": self._n_devices,
+            "mfu": latest.get("perf/mfu"),
+            # the peak-FLOPs table fell back to a nominal constant → the
+            # MFU number above is not trustworthy
+            "mfu_trusted": bool(self._peak_flops_known),
+            "peak_flops_per_chip": self._peak_flops,
+            "staleness_avg": latest.get("orchestrator/staleness_avg"),
+            "health": self.health.snapshot(),
+        }
+        if orch is not None and hasattr(orch, "status_snapshot"):
+            out.update(orch.status_snapshot())
+        return out
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -1942,6 +2021,10 @@ class RLTrainer:
             ))
             metrics.update(self.timer.summary())
             self.state["global_step"] += 1
+            # run-health plane: fold this row into the streaming aggregates,
+            # evaluate the anomaly rules, and ride the health/* gauges on
+            # the same record (CRIT side effects happen inside observe)
+            metrics.update(self.health.observe(self.state["global_step"], metrics))
             if self.state["global_step"] % cfg.logging_steps == 0:
                 self.logger.log(self.state["global_step"], self.state["episode"], metrics)
                 self.logger.log_samples(
@@ -2049,7 +2132,12 @@ class RLTrainer:
                        "resilience": {
                            "sentinel": self.sentinel.journal(),
                            "watchdog": self.watchdog.journal(),
-                       }}
+                       },
+                       # health-plane journal: aggregate sketches, rule
+                       # levels, verdict, trip counts — a resumed run keeps
+                       # its learned baselines instead of re-warming and
+                       # missing a collapse that started pre-restart
+                       "health": self.health.journal()}
         if orch is not None:
             # journal the queue: pending (dispatched, unconsumed)
             # indices + cumulative drop/staleness counters. Resume
@@ -2215,6 +2303,12 @@ class RLTrainer:
         if res:
             self.sentinel.restore(res.get("sentinel", {}))
             self.watchdog.restore(res.get("watchdog", {}))
+        # health journal: restored baselines (EWMA/P² sketches), rule
+        # levels, verdict + trip counts — same continuity contract as the
+        # fleet counters. Windowed rates re-warm (monotonic clock).
+        h = tstate.get("health")
+        if h:
+            self.health.restore(h)
         self._reset_data_iterator()
         return self.state
 
@@ -2232,6 +2326,9 @@ class RLTrainer:
         )
 
     def close(self):
+        # stop serving status endpoints first: the handlers read trainer
+        # state that the teardown below starts dismantling
+        self.exporter.close()
         if self._orchestrator is not None:
             self._orchestrator.close()  # stop + join the producer thread
             self._orchestrator = None
