@@ -43,6 +43,10 @@ BENCH_HEALTH (1: also measure with the run-health plane disabled and report
 detail.health.health_overhead_frac — the streaming-aggregator + rule-eval
 cost of the default-on health monitor; acceptance < 1% of step wall,
 docs/OBSERVABILITY.md §5),
+BENCH_LINEAGE (1: also measure with the sample-lineage ledger enabled and
+report detail.lineage.lineage_overhead_frac — the per-rollout provenance
+JSONL appends' cost on the step wall; acceptance < 1%,
+docs/OBSERVABILITY.md §6),
 BENCH_FLEET_WORKERS (0: >1 also measures the elastic rollout fleet at that
 worker count against the single-producer pipeline at the SAME staleness
 and reports detail.fleet.coordinator_overhead_frac — the lease/reorder
@@ -455,7 +459,8 @@ def _spec_decode_check(jax) -> dict:
         SamplingParams(greedy=True, max_tokens=resp, spec_k=spec_k),
         stats_out=stats,
     )
-    st = {k: int(np.asarray(v)) for k, v in stats[-1].items()}
+    st = {k: int(np.asarray(v)) for k, v in stats[-1].items()
+          if np.asarray(v).ndim == 0}  # scalars only (accepted_rows is [B])
     mono_steps = resp - 1                               # one dispatch/token after prefill
     identical = bool(np.array_equal(np.asarray(out0), np.asarray(out1)))
     return {
@@ -623,7 +628,8 @@ def run_bench(jax, init_error):
 
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
-                telemetry=False, spec_k=None, workers=1, health=True):
+                telemetry=False, spec_k=None, workers=1, health=True,
+                lineage=False):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -659,6 +665,7 @@ def run_bench(jax, init_error):
             sentinel=sentinel,
             telemetry=telemetry,
             health=health,
+            lineage=lineage,
             kv_cache_quant=kv_quant,
             rollout_spec_k=spec_k,
             gradient_checkpointing=True,
@@ -910,6 +917,37 @@ def run_bench(jax, init_error):
         except Exception as e:
             health_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # lineage-ledger overhead A/B (docs/OBSERVABILITY.md §6 acceptance: the
+    # per-rollout provenance writes — lease/generation/queue/reward/outcome
+    # JSONL appends — cost < 1% of step wall when cfg.lineage is on): the
+    # chosen config ran with lineage OFF (the default), so re-measure with
+    # the ledger enabled and report on-vs-off. Same budget gate as the
+    # other observability A/Bs.
+    lineage_detail = None
+    if (os.environ.get("BENCH_LINEAGE", "1") == "1"
+            and budget - (time.time() - _T0) > 0.9 * t_baseline):
+        try:
+            lineage_on = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"],
+                capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
+                lineage=True,
+            )
+            on_sec = lineage_on["sec_per_update_steady"]
+            lineage_detail = {
+                "off_sec_per_update": chosen["sec_per_update_steady"],
+                "on_sec_per_update": on_sec,
+                "lineage_overhead_frac": round(
+                    (on_sec - chosen["sec_per_update_steady"])
+                    / max(chosen["sec_per_update_steady"], 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            lineage_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # fleet-coordinator overhead A/B (docs/FLEET.md acceptance: the lease /
     # reorder-buffer / liveness machinery costs < 2% of step wall): measure
     # the single-producer pipeline and the N-worker fleet at the SAME
@@ -1077,6 +1115,8 @@ def run_bench(jax, init_error):
         detail["telemetry"] = telemetry_detail
     if health_detail is not None:
         detail["health"] = health_detail
+    if lineage_detail is not None:
+        detail["lineage"] = lineage_detail
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
     if short_detail is not None:

@@ -70,6 +70,7 @@ from nanorlhf_tpu.orchestrator.sample_queue import (
 )
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
 from nanorlhf_tpu.resilience.retry import backoff_delay
+from nanorlhf_tpu.telemetry.lineage import spec_summary
 
 
 class FleetExhausted(ProducerFailed):
@@ -170,6 +171,7 @@ class FleetCoordinator:
         faults=None,
         tracer=None,
         meter=None,
+        lineage=None,
     ):
         self.cfg = config or FleetConfig()
         self._queue = queue
@@ -178,6 +180,9 @@ class FleetCoordinator:
         self._faults = faults
         self._tracer = tracer
         self._meter = meter  # OverlapMeter: retire a lost worker's track
+        # telemetry.LineageLedger: lease-grant provenance (lease/worker ids,
+        # reassigned_from on a re-grant) + late-duplicate drop attribution
+        self._lineage = lineage
         self._cond = threading.Condition()
         self._workers: dict[int, _WorkerRecord] = {}
         self._waiters: list[int] = []
@@ -348,6 +353,16 @@ class FleetCoordinator:
         )
         self._leases[lease.lease_id] = lease
         self.counters["leases_granted"] += 1
+        if self._lineage is not None and self._lineage.enabled:
+            # one lease event per covered index: the chain for a rollout
+            # index joins on rollout_index, and a reassigned lease's second
+            # event carries BOTH worker ids (worker_id + reassigned_from)
+            for o in range(len(batches)):
+                self._lineage.lease(
+                    start + o, lease_id=lease.lease_id, worker_id=worker_id,
+                    reassigned_from=reassigned_from, cursor=start + o,
+                    length=len(batches),
+                )
         return lease
 
     def _deadline_s(self, length: int) -> float:
@@ -394,6 +409,15 @@ class FleetCoordinator:
             )
             if self._index_done_locked(index):
                 self.counters["duplicate_samples"] += 1
+                if self._lineage is not None:
+                    # a straggler's result landing after its speculative
+                    # replacement already delivered: the SAMPLES are not
+                    # lost (the winner's are trained on) — the duplicate
+                    # batch is what hits the floor
+                    self._lineage.drop(
+                        index, "fleet_late_duplicate", worker_id=worker_id,
+                        lease_id=lease.lease_id,
+                    )
                 self._cond.notify_all()
                 return False
             self._done.add(index)
@@ -695,13 +719,14 @@ class RolloutWorker:
 
     def __init__(self, worker_id: int, coordinator: FleetCoordinator,
                  transport: FleetTransport, meter=None, faults=None,
-                 tracer=None):
+                 tracer=None, lineage=None):
         self.worker_id = worker_id
         self._coord = coordinator
         self._transport = transport
         self._meter = meter
         self._faults = faults
         self._tracer = tracer
+        self._lineage = lineage
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -787,6 +812,12 @@ class RolloutWorker:
                 t1 = time.time()
                 if self._meter is not None:
                     self._meter.note_gen(t0, t1, track=self.worker_id)
+                if self._lineage is not None and self._lineage.enabled:
+                    self._lineage.generation(
+                        index, policy_version=version,
+                        worker_id=self.worker_id, lease_id=lease.lease_id,
+                        gen_s=round(t1 - t0, 6), spec=spec_summary(payload),
+                    )
                 self._coord.complete(
                     self.worker_id, lease, index,
                     QueuedSample(index, version, payload, t0, t1),
@@ -847,6 +878,7 @@ class FleetOrchestrator:
         faults=None,
         tracer=None,
         fleet: Optional[FleetConfig] = None,
+        lineage=None,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers} must be >= 1")
@@ -855,16 +887,18 @@ class FleetOrchestrator:
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
         self.queue = BoundedStalenessQueue(
-            max_staleness, policy, start_index=start_index
+            max_staleness, policy, start_index=start_index, lineage=lineage
         )
         self.meter = meter if meter is not None else OverlapMeter()
         self.max_staleness = max_staleness
         self._heartbeat = heartbeat
         self._faults = faults
         self._tracer = tracer
+        self._lineage = lineage
         self.coordinator = FleetCoordinator(
             queue=self.queue, batch_fn=batch_fn, start_index=start_index,
             config=fleet, faults=faults, tracer=tracer, meter=self.meter,
+            lineage=lineage,
         )
         if restore:
             self.queue.restore_counters(restore)
@@ -892,7 +926,7 @@ class FleetOrchestrator:
         self._next_worker_id += 1
         w = RolloutWorker(
             wid, self.coordinator, self.transport, meter=self.meter,
-            faults=self._faults, tracer=self._tracer,
+            faults=self._faults, tracer=self._tracer, lineage=self._lineage,
         )
         # register BEFORE start: the worker's first acquire must find its
         # membership record (alive() treats not-yet-started as alive)

@@ -34,6 +34,7 @@ from nanorlhf_tpu.orchestrator.sample_queue import (
     QueuedSample,
 )
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
+from nanorlhf_tpu.telemetry.lineage import spec_summary as _spec_summary
 
 
 def _merge_intervals(ivs: list[tuple[float, float]]) -> list[tuple[float, float]]:
@@ -224,11 +225,12 @@ class RolloutOrchestrator:
         heartbeat: float = 30.0,
         faults=None,
         tracer=None,
+        lineage=None,
     ):
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
         self.queue = BoundedStalenessQueue(
-            max_staleness, policy, start_index=start_index
+            max_staleness, policy, start_index=start_index, lineage=lineage
         )
         if restore:
             self.queue.restore_counters(restore)
@@ -241,6 +243,9 @@ class RolloutOrchestrator:
         # telemetry.SpanTracer: generation spans land on the producer
         # thread's own track — the trainer-vs-producer overlap picture
         self._tracer = tracer
+        # telemetry.LineageLedger: per-index lease + generation provenance
+        # (the single producer is "worker 0" with an implicit lease)
+        self._lineage = lineage
         self.producer_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -264,6 +269,11 @@ class RolloutOrchestrator:
                     # an unburned cursor (docs/RESILIENCE.md)
                     self._faults.fire("rollout.produce")
                 version, tree = self.store.latest()
+                lin = self._lineage
+                if lin is not None and lin.enabled:
+                    # the single producer IS the lease grant: the dispatch
+                    # below burns the data cursor + PRNG stream for `idx`
+                    lin.lease(idx, worker_id=0, cursor=idx, length=1)
                 tr = self._tracer
                 span = (
                     # the producer is one long-lived thread, so the span
@@ -283,6 +293,12 @@ class RolloutOrchestrator:
                     jax.block_until_ready(payload)
                 t1 = time.time()
                 self.meter.note_gen(t0, t1)
+                if lin is not None and lin.enabled:
+                    lin.generation(
+                        idx, policy_version=version, worker_id=0,
+                        gen_s=round(t1 - t0, 6),
+                        spec=_spec_summary(payload),
+                    )
                 self.queue.put(QueuedSample(idx, version, payload, t0, t1))
                 if tr is not None and tr.enabled:
                     tr.counter("orchestrator/queue_depth", self.queue.depth())

@@ -52,13 +52,17 @@ class QueuedSample:
 
 class BoundedStalenessQueue:
     def __init__(self, max_staleness: int, policy: str = "wait",
-                 start_index: int = 0):
+                 start_index: int = 0, lineage=None):
         if max_staleness < 0:
             raise ValueError(f"max_staleness={max_staleness} must be >= 0")
         if policy not in ("wait", "drop"):
             raise ValueError(f"staleness policy {policy!r}: wait | drop")
         self.max_staleness = max_staleness
         self.policy = policy
+        # lineage ledger (telemetry/lineage.py): queue-transit events —
+        # enqueue/dequeue monotonic times + staleness at consumption — and
+        # stale-drop attribution. None/disabled = no-op.
+        self._lineage = lineage
         self.maxsize = max_staleness + 1
         self._base = start_index     # gate arithmetic is RELATIVE to this
         self._q: collections.deque[QueuedSample] = collections.deque()
@@ -158,11 +162,25 @@ class BoundedStalenessQueue:
                     if (self.policy == "drop"
                             and staleness > self.max_staleness):
                         self.dropped += 1
+                        if self._lineage is not None:
+                            self._lineage.drop(
+                                s.index, "stale_drop", staleness=staleness,
+                                policy_version=s.version,
+                            )
                         self._cond.notify_all()
                         continue
                     self.staleness_counts[staleness] = (
                         self.staleness_counts.get(staleness, 0) + 1
                     )
+                    if (self._lineage is not None
+                            and self._lineage.enabled):
+                        # dispatch/ready stamps share the producer's clock
+                        # (time.time), so queue wait = dequeue_t - enqueue_t
+                        self._lineage.queue(
+                            s.index, enqueue_t=s.ready_time,
+                            dequeue_t=time.time(),
+                            staleness=staleness, policy_version=s.version,
+                        )
                     self._cond.notify_all()
                     return s
                 if self._error is not None:  # buffer drained: surface it

@@ -191,7 +191,7 @@ def _verify_fn(params, config, state, drafts, *, Tp, max_tokens,
     writes, per-row cache-length/key_mask advance, EOS/budget termination,
     and the acceptance counters."""
     (it, out, lp_out, caches, key_mask, done, cur_tok, n_gen, prompt_len,
-     key, n_drafted, n_accepted, n_emitted, n_rowsteps) = state
+     key, n_drafted, n_accepted, n_emitted, n_rowsteps, row_acc) = state
     B = cur_tok.shape[0]
     K1 = spec_k + 1
     arange = jnp.arange(K1)[None, :]
@@ -254,14 +254,15 @@ def _verify_fn(params, config, state, drafts, *, Tp, max_tokens,
     done = done | eos_emitted | (n_gen >= max_tokens)
 
     liv = live.astype(jnp.int32)
+    acc_row = liv * jnp.minimum(acc, jnp.maximum(n_emit - 1, 0))  # [B]
     n_drafted = n_drafted + jnp.sum(liv) * spec_k
-    n_accepted = n_accepted + jnp.sum(
-        liv * jnp.minimum(acc, jnp.maximum(n_emit - 1, 0))
-    )
+    n_accepted = n_accepted + jnp.sum(acc_row)
     n_emitted = n_emitted + jnp.sum(n_emit)
     n_rowsteps = n_rowsteps + jnp.sum(liv)     # live (row, verify-step) pairs
+    row_acc = row_acc + acc_row  # per-row accepted drafts (lineage ledger)
     return (it + 1, out, lp_out, caches, key_mask, done, cur_tok, n_gen,
-            prompt_len, key, n_drafted, n_accepted, n_emitted, n_rowsteps)
+            prompt_len, key, n_drafted, n_accepted, n_emitted, n_rowsteps,
+            row_acc)
 
 
 def _spec_state(base_state):
@@ -274,7 +275,7 @@ def _spec_state(base_state):
     zero = jnp.int32(0)
     return (jnp.int32(1), out, lp_out, caches, key_mask, done, tok,
             jnp.ones((B,), jnp.int32), prompt_len, key, zero, zero, zero,
-            zero)
+            zero, jnp.zeros((B,), jnp.int32))
 
 
 @partial(jax.jit, static_argnames=_GEN_STATIC)
@@ -302,7 +303,8 @@ def generate_tokens_spec(
     """Jitted speculative decode loop (the async default). Same output
     contract as `generate_tokens` plus a stats tuple:
     (tokens [B*fanout, max_tokens], logprobs f32, (verify_steps, drafted,
-    accepted, emitted, row_steps) int32 device scalars). `verify_steps` is
+    accepted, emitted, row_steps, accepted_rows) — int32 device scalars
+    plus a per-row [B*fanout] accepted-draft vector). `verify_steps` is
     the decode dispatch count — the number the monolithic loop pays once
     per token; `row_steps` counts live (row, verify-step) pairs, so
     emitted/row_steps is mean tokens per row per dispatch (monolithic:
@@ -339,7 +341,8 @@ def generate_tokens_spec(
         return _verify_fn(params, config, s, drafts, **statics)
 
     state = jax.lax.while_loop(cond, body, state)
-    stats = (state[0] - 1, state[10], state[11], state[12], state[13])
+    stats = (state[0] - 1, state[10], state[11], state[12], state[13],
+             state[14])
     return state[1], state[2], stats
 
 
@@ -392,7 +395,8 @@ def _generate_spec_instrumented(params, config, prompt_ids, prompt_mask, key,
             state = _verify_jit(params, config, state, drafts, Tp=Tp,
                                 **ver_kw)
             jax.block_until_ready(state[5])
-    stats = (state[0] - 1, state[10], state[11], state[12], state[13])
+    stats = (state[0] - 1, state[10], state[11], state[12], state[13],
+             state[14])
     return state[1], state[2], stats
 
 
@@ -441,10 +445,13 @@ def generate_spec(
             params, config, prompt_ids, prompt_mask, key, **kw
         )
     if spec_stats_out is not None:
-        steps, drafted, accepted, emitted, row_steps = stats
+        steps, drafted, accepted, emitted, row_steps, accepted_rows = stats
         spec_stats_out.append({
             "verify_steps": steps, "drafted": drafted,
             "accepted": accepted, "emitted": emitted,
             "row_steps": row_steps,
+            # per-row accepted-draft counts [B]: the lineage ledger's
+            # generation events attribute draft acceptance per sample
+            "accepted_rows": accepted_rows,
         })
     return (out, lp) if capture_logprobs else out

@@ -3,9 +3,11 @@ with a flight-recorder ring (tracer.py — Chrome trace-event JSON, Perfetto-
 loadable), analytic MFU/throughput accounting with a jax.monitoring
 recompile counter (mfu.py), and the run-health plane — streaming anomaly
 detection over the metric stream (health.py) plus a live /metrics ·
-/healthz · /statusz HTTP exporter (exporter.py). tracer/health/exporter
-are jax-free; mfu.py imports jax lazily — bench's jax-averse parent can
-load any of them by file path."""
+/healthz · /statusz HTTP exporter (exporter.py), and the per-sample
+lineage ledger — end-to-end rollout provenance with drop attribution
+(lineage.py, queried by tools/inspect_run.py). tracer/health/exporter/
+lineage are jax-free; mfu.py imports jax lazily — bench's jax-averse
+parent can load any of them by file path."""
 
 from nanorlhf_tpu.telemetry.exporter import (
     StatusExporter,
@@ -17,6 +19,12 @@ from nanorlhf_tpu.telemetry.health import (
     HealthConfig,
     HealthMonitor,
     HealthRule,
+)
+from nanorlhf_tpu.telemetry.lineage import (
+    LineageLedger,
+    chains,
+    drop_histogram,
+    read_ledger,
 )
 from nanorlhf_tpu.telemetry.mfu import (
     BACKEND_COMPILE_EVENT,
@@ -41,12 +49,16 @@ __all__ = [
     "HealthConfig",
     "HealthMonitor",
     "HealthRule",
+    "LineageLedger",
     "PEAK_FLOPS_PER_CHIP",
     "RecompileCounter",
     "SpanTracer",
     "StatusExporter",
+    "chains",
+    "drop_histogram",
     "flops_param_count",
     "peak_flops_per_chip",
+    "read_ledger",
     "recompile_counter",
     "render_prometheus",
     "update_flops",

@@ -41,18 +41,26 @@ def render_prometheus(metrics: dict, prefix: str = "nanorlhf_") -> str:
     """Render a flat {name: scalar} dict as Prometheus text exposition
     (version 0.0.4). Metric names like `perf/mfu` sanitize to
     `nanorlhf_perf_mfu`; non-numeric values are skipped; NaN/±Inf are
-    legal exposition values and pass through."""
+    legal exposition values and pass through. A key carrying a label set
+    (`lineage/dropped_total{reason="stale_drop"}`) keeps its labels
+    verbatim — only the name part is sanitized — and shares one # TYPE
+    line with its sibling series."""
     lines: list[str] = []
     seen: set = set()
+    typed: set = set()
     for key in sorted(metrics):
         try:
             v = float(metrics[key])
         except (TypeError, ValueError):
             continue
-        name = prefix + _NAME_RE.sub("_", str(key))
-        if name in seen:  # two raw keys can sanitize to the same name
+        raw, labels = str(key), ""
+        if raw.endswith("}") and "{" in raw:
+            raw, _, tail = raw.partition("{")
+            labels = "{" + tail
+        name = prefix + _NAME_RE.sub("_", raw)
+        if (name, labels) in seen:  # two raw keys can sanitize the same
             continue
-        seen.add(name)
+        seen.add((name, labels))
         if v != v:
             val = "NaN"
         elif v == float("inf"):
@@ -61,8 +69,10 @@ def render_prometheus(metrics: dict, prefix: str = "nanorlhf_") -> str:
             val = "-Inf"
         else:
             val = repr(v)
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {val}")
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {val}")
     return "\n".join(lines) + "\n"
 
 

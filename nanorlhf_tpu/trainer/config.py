@@ -377,6 +377,22 @@ class RLConfig:
     health_arm_sentinel: bool = False     # CRIT enables TrainingSentinel if off
     status_port: int = 0
     status_host: str = "127.0.0.1"
+    # sample lineage ledger (telemetry/lineage.py, docs/OBSERVABILITY.md
+    # §6): one joinable provenance stream per rollout index — lease grant
+    # (lease/worker ids, PRNG fold-in path), generation (policy version,
+    # spec-decode per-row acceptance), queue transit (staleness at
+    # consumption), reward (score, retry attempt, grader wall), and
+    # training outcome (advantage, kept vs dropped with a machine-readable
+    # drop_reason) — as size-rotated append-only JSONL under
+    # <output_dir>/lineage/. Query with tools/inspect_run.py; drop-reason
+    # counters + a last-N sample ring ride /statusz and /metrics. Off by
+    # default; the bench A/B (detail.lineage) holds the enabled overhead
+    # under 1% of step wall.
+    lineage: bool = False
+    # fraction of rollout indices recorded (deterministic per-index hash:
+    # a sampled index keeps its COMPLETE lease→...→outcome chain; others
+    # are skipped at every layer). Drop counters stay exact regardless.
+    lineage_sample_rate: float = 1.0
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1
@@ -393,6 +409,11 @@ class RLConfig:
     eval_steps: int = 1
     logging_steps: int = 1
     num_printed_samples: int = 5         # rich-table rows (`GRPO/grpo_trainer.py:717`)
+    # rows per update routed into the lineage ledger's full-text `sample`
+    # events (metrics.jsonl no longer carries sample rows — they polluted
+    # the metric-row contract consumers like the health monitor iterate).
+    # None -> num_printed_samples, the console table's row count.
+    log_samples_limit: Optional[int] = None
     report_to: str = "jsonl"             # "jsonl" | "none" (wandb needs egress)
 
     # ---- mesh ----

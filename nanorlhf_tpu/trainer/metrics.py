@@ -100,19 +100,16 @@ class MetricsLogger:
     def log_samples(self, step: int, queries: list[str], responses: list[str],
                     scores, limit: int = 5):
         """Console sample table — the rich-table parity
-        (`GRPO/grpo_trainer.py:711-724`)."""
+        (`GRPO/grpo_trainer.py:711-724`). Console only: full-text sample
+        records go to the lineage ledger's `sample` events
+        (telemetry/lineage.py), NOT into metrics.jsonl — interleaved
+        sample rows broke the metric-row contract every JSONL consumer
+        (health monitor, inspect_run, resume tests) iterates."""
         print(f"--- samples @ step {step} ---")
         for q, r, s in list(zip(queries, responses, scores))[:limit]:
             q1 = q.replace("\n", " ")[:80]
             r1 = r.replace("\n", " ")[:120]
             print(f"  score={float(s):+.3f} | {q1!r} -> {r1!r}")
-        if self._fh:
-            rows = [
-                {"query": q, "response": r, "score": float(s)}
-                for q, r, s in list(zip(queries, responses, scores))[:limit]
-            ]
-            self._fh.write(json.dumps({"step": step, "samples": rows}) + "\n")
-            self._fh.flush()
 
     def close(self):
         """Flush + close both sinks. Idempotent (also runs as the atexit

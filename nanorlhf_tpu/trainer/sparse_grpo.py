@@ -363,6 +363,9 @@ class SparseGRPOTrainer(RLTrainer):
                     "spec_stats": spec_stats[0] if spec_stats else None}
 
         stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
+        # lineage (telemetry/lineage.py): whole-rollout drops are counted
+        # in samples — one rollout here is batch_size*n completion rows
+        self.lineage.rows_hint = cfg.batch_size * n
         for update in range(1, n_updates + 1):
             t_start = time.time()
             step_t0 = time.perf_counter()
@@ -375,8 +378,19 @@ class SparseGRPOTrainer(RLTrainer):
             # ---- rollout + reward -----------------------------------------
             t_roll0 = time.perf_counter()
             ro = stream.fetch_or_dispatch()
+            rollout_index = ro["_index"]
             queries = ro["queries"]
             batch_size = queries.shape[0]
+            if self.lineage.enabled:
+                # serial loop: generation provenance is emitted here (the
+                # stream's dispatch already logged the lease event)
+                from nanorlhf_tpu.telemetry.lineage import spec_summary
+
+                self.lineage.generation(
+                    rollout_index,
+                    policy_version=self.state["global_step"], worker_id=0,
+                    spec=spec_summary(ro),
+                )
             if capture:
                 responses, captured_lp = ro["gen_out"]
                 responses = np.asarray(responses)
@@ -395,8 +409,15 @@ class SparseGRPOTrainer(RLTrainer):
             ]
             question_n = [q for q in question_strings for _ in range(n)]
             decoded = tok.batch_decode(responses)
+            t_rwd0 = time.perf_counter()
             raw_scores = self._call_reward(
                 [q + r for q, r in zip(question_n, decoded)], responses
+            )
+            self.lineage.reward(
+                rollout_index, step=self.state["global_step"],
+                scores=[round(float(s), 6) for s in raw_scores.tolist()],
+                attempt=1,  # _call_reward has no retry loop
+                wall_s=round(time.perf_counter() - t_rwd0, 6),
             )
             mean_raw_score = float(raw_scores.mean())
             log_responses_length = float(
@@ -412,10 +433,31 @@ class SparseGRPOTrainer(RLTrainer):
             responses = responses.reshape(batch_size, n, -1)[rows, keep]
             if captured_lp is not None:
                 captured_lp = captured_lp.reshape(batch_size, n, -1)[rows, keep]
+            if n > 1:
+                # the other n−1 completions per prompt leave the batch here
+                self.lineage.drop(
+                    rollout_index, "keep_filter",
+                    count=batch_size * (n - 1),
+                    step=self.state["global_step"],
+                )
 
             # ---- sparse filter (`grpo_r1_trainer.py:565-568`) -------------
             nz = np.where(scores != 0)[0]
             kept_frac = len(nz) / max(batch_size, 1)
+            if self.lineage.enabled:
+                # the paper's silent zero-advantage skip, made loud: one
+                # drop event PER EXCLUDED ROW — the attribution the sparse
+                # filter never had (every dropped row has exactly one
+                # machine-readable drop_reason)
+                for r in np.where(scores == 0)[0]:
+                    self.lineage.drop(
+                        rollout_index, "sparse_zero_advantage",
+                        row=int(r), step=self.state["global_step"],
+                        raw_score=round(
+                            float(raw_scores.reshape(batch_size, n)[r, keep[r]]),
+                            6,
+                        ),
+                    )
             if len(nz) == 0:
                 print(f"[sparse-grpo] update {update}: all advantages zero, skipping")
                 # skip marker in the trace: a starved streak shows up as a
@@ -676,14 +718,51 @@ class SparseGRPOTrainer(RLTrainer):
             metrics.update(
                 self.health.observe(self.state["global_step"], metrics)
             )
+            kept_scores = raw_scores.reshape(batch_size, n)[rows, keep]
+            if self.lineage.enabled:
+                # outcome closes the chain: kept rows survived BOTH the
+                # keep-1-of-N draw and the sparse zero-advantage filter
+                self.lineage.outcome(
+                    rollout_index, step=self.state["global_step"],
+                    policy_version=self.state["global_step"],
+                    kept=int(local_bs),
+                    advantage=round(float(scores.mean()), 6),
+                    scores=[round(float(s), 6) for s in kept_scores.tolist()],
+                    kept_frac=round(kept_frac, 4),
+                )
+                for r in nz[:8]:
+                    self.lineage.note_sample(
+                        rollout_index, step=self.state["global_step"],
+                        score=round(float(kept_scores[r]), 6),
+                        response_chars=len(decoded[r * n + keep[r]]),
+                        kept=True,
+                    )
             if self.state["global_step"] % cfg.logging_steps == 0:
                 self.logger.log(self.state["global_step"], self.state["episode"], metrics)
                 kept_decoded = [decoded[i * n + j] for i, j in enumerate(keep)]
+                sample_limit = (
+                    cfg.log_samples_limit
+                    if cfg.log_samples_limit is not None
+                    else cfg.num_printed_samples
+                )
                 self.logger.log_samples(
                     self.state["global_step"], question_strings, kept_decoded,
-                    raw_scores.reshape(batch_size, n)[rows, keep],
-                    cfg.num_printed_samples,
+                    kept_scores, sample_limit,
                 )
+                if self.lineage.enabled:
+                    # full-text records belong to the ledger, not
+                    # metrics.jsonl (see MetricsLogger.log_samples)
+                    for i, (q, r_txt, s) in enumerate(zip(
+                            question_strings, kept_decoded,
+                            kept_scores.tolist())):
+                        if i >= sample_limit:
+                            break
+                        self.lineage.event(
+                            "sample", rollout_index,
+                            step=self.state["global_step"], row=i,
+                            query=q, response=r_txt,
+                            score=round(float(s), 6),
+                        )
             saved_this_step = False
             if cfg.save_steps and self.state["global_step"] % cfg.save_steps == 0:
                 self._sparse_save(metrics)
@@ -749,5 +828,6 @@ class SparseGRPOTrainer(RLTrainer):
                              "sentinel": self.sentinel.journal(),
                              "watchdog": self.watchdog.journal(),
                          },
-                         "health": self.health.journal()},
+                         "health": self.health.journal(),
+                         "lineage": self.lineage.journal()},
         )

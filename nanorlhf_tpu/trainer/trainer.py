@@ -73,7 +73,8 @@ from nanorlhf_tpu.ops.masking import (
 from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
                                         shard_params)
 from nanorlhf_tpu.sampler import SamplingParams, generate
-from nanorlhf_tpu.telemetry import (HealthConfig, HealthMonitor, SpanTracer,
+from nanorlhf_tpu.telemetry import (HealthConfig, HealthMonitor,
+                                    LineageLedger, SpanTracer,
                                     StatusExporter, flops_param_count,
                                     peak_flops_per_chip, recompile_counter,
                                     update_flops)
@@ -222,6 +223,11 @@ class RolloutStream:
         t = self._t
         queries = np.asarray(next(t._iter))
         key = jax.random.fold_in(t._rollout_base, self._idx)
+        lin = getattr(t, "lineage", None)
+        if lin is not None and lin.enabled:
+            # serial/rollout_ahead runs have no coordinator: the dispatch
+            # itself is the lease grant (worker 0, cursor == index)
+            lin.lease(self._idx, worker_id=0, cursor=self._idx, length=1)
         t0 = time.time()
         ro = self._body(queries, key)
         # hand the watcher a FROZEN view of the async outputs — blocking on
@@ -258,6 +264,10 @@ class RolloutStream:
         idx = self._idx
         self._idx += 1
         self._t.state["rollouts"] = self._idx
+        lin = getattr(self._t, "lineage", None)
+        if lin is not None:
+            lin.drop(idx, "sentinel_quarantine",
+                     step=self._t.state["global_step"], dispatched=False)
         return idx
 
 
@@ -559,6 +569,20 @@ class RLTrainer:
             tracer=self.tracer,
         )
         self.logger = MetricsLogger(config.output_dir, config.report_to)
+        # sample lineage ledger (telemetry/lineage.py, docs/OBSERVABILITY.md
+        # §6): per-rollout-index provenance — lease, generation, queue,
+        # reward, outcome, drop — as rotated JSONL under
+        # <telemetry_dir>/lineage/. Off by default; disabled, every emit is
+        # a cheap no-op so the instrumentation stays inline unconditionally
+        # (bench's detail.lineage A/B is the overhead gate). The key_path
+        # string documents the generation-PRNG derivation on lease events
+        # (RolloutStream.dispatch below holds the actual fold_in).
+        self.lineage = LineageLedger(
+            self._telemetry_dir,
+            enabled=config.lineage,
+            sample_rate=config.lineage_sample_rate,
+            key_path="fold_in(fold_in(seed_key, 0x5E11), rollout_index)",
+        )
         # run-health plane (telemetry/health.py, docs/OBSERVABILITY.md §5):
         # every metrics row folds through streaming aggregates + anomaly
         # rules; CRIT dumps a reason="health" blackbox through the tracer
@@ -582,7 +606,7 @@ class RLTrainer:
         self.exporter = StatusExporter(
             config.status_port,
             host=config.status_host,
-            metrics_fn=self.logger.latest,
+            metrics_fn=self._export_metrics,
             health=self.health,
             statusz_fn=self._statusz,
         )
@@ -753,6 +777,7 @@ class RLTrainer:
                     heartbeat=cfg.producer_heartbeat,
                     faults=self.faults,
                     tracer=self.tracer,
+                    lineage=self.lineage,
                     fleet=FleetConfig(
                         lease_size=cfg.fleet_lease_size,
                         failure_budget=cfg.fleet_failure_budget,
@@ -789,6 +814,7 @@ class RLTrainer:
                     heartbeat=cfg.producer_heartbeat,
                     faults=self.faults,
                     tracer=self.tracer,
+                    lineage=self.lineage,
                 )
             self._orch_restore_state = None
         return self._orchestrator
@@ -935,10 +961,20 @@ class RLTrainer:
             "peak_flops_per_chip": self._peak_flops,
             "staleness_avg": latest.get("orchestrator/staleness_avg"),
             "health": self.health.snapshot(),
+            # drop-reason counts since start + the last-N sample ring
+            # (telemetry/lineage.py) — the live companion to the ledger
+            "lineage": self.lineage.statusz(),
         }
         if orch is not None and hasattr(orch, "status_snapshot"):
             out.update(orch.status_snapshot())
         return out
+
+    def _export_metrics(self) -> dict:
+        """/metrics provider: the latest flat metric row plus the lineage
+        ledger's labeled drop-reason gauges
+        (`lineage/dropped_total{reason=...}`) — render_prometheus keeps the
+        label set verbatim, so these survive validate_prometheus_text."""
+        return {**self.logger.latest(), **self.lineage.metric_rows()}
 
     # ------------------------------------------------------------------ #
     # optimizer
@@ -1595,12 +1631,20 @@ class RLTrainer:
                         f"[resilience] skipping quarantined rollout "
                         f"{ro['_index']} (sentinel rollback)"
                     )
+                    self.lineage.drop(
+                        ro["_index"], "sentinel_quarantine",
+                        step=self.state["global_step"], dispatched=True,
+                    )
                     if use_orch:
                         orch.consumed_without_update()
                     continue
                 return ro
 
         ensure_handles()
+        # whole-rollout drops (queue stale_drop, fleet late-duplicate) are
+        # denominated in samples via this hint — one rollout = batch_size*n
+        # completion rows
+        self.lineage.rows_hint = cfg.batch_size * n
         sample_staleness, queue_depth = 0, 0
         target_step = self.state["global_step"] + n_updates
         while self.state["global_step"] < target_step:
@@ -1629,6 +1673,18 @@ class RLTrainer:
                 if greedy_responses is not None:
                     greedy_responses.block_until_ready()
             t_busy0 = time.time()  # overlap meter: consumer busy from here
+            if not use_orch and self.lineage.enabled:
+                # serial / rollout_ahead path has no producer thread to emit
+                # this: generation provenance lands here, once the arrays
+                # are device-ready (policy version == global_step — the same
+                # convention the trace spans use without an orchestrator)
+                from nanorlhf_tpu.telemetry.lineage import spec_summary
+
+                self.lineage.generation(
+                    rollout_index,
+                    policy_version=self.state["global_step"], worker_id=0,
+                    spec=spec_summary(ro),
+                )
             self.state["episode"] += cfg.batch_size
             queries = ro["queries"]
             batch_size, context_length = queries.shape
@@ -1650,6 +1706,8 @@ class RLTrainer:
                 scores = self._dispatch_reward(
                     [q + r for q, r in zip(question_n, responses_decoded)],
                     tok.eos_token,
+                    rollout_index=rollout_index,
+                    step=self.state["global_step"],
                 )
             log_scores_all = scores.copy()  # raw sampled-rollout scores for logging
             if greedy_responses is not None:
@@ -1679,6 +1737,14 @@ class RLTrainer:
                 responses_decoded = [
                     responses_decoded[i * n + j] for i, j in enumerate(keep)
                 ]
+                if n > 1:
+                    # the other n−1 completions per prompt leave the batch
+                    # here: attribute them like any other exclusion
+                    self.lineage.drop(
+                        rollout_index, "keep_filter",
+                        count=batch_size * (n - 1),
+                        step=self.state["global_step"],
+                    )
                 queries_rep = queries
             else:
                 queries_rep = np.repeat(queries, n, axis=0) if n > 1 else queries
@@ -1779,6 +1845,11 @@ class RLTrainer:
                 log_scores = log_scores.reshape(batch_size, n)[
                     np.arange(batch_size), keep_inds
                 ]
+                self.lineage.drop(
+                    rollout_index, "keep_filter",
+                    count=batch_size * (n - 1),
+                    step=self.state["global_step"],
+                )
 
             # ---- PPO-epoch / minibatch / microbatch update ----------------
             trainable, frozen = self._partition(
@@ -2025,12 +2096,70 @@ class RLTrainer:
             # evaluate the anomaly rules, and ride the health/* gauges on
             # the same record (CRIT side effects happen inside observe)
             metrics.update(self.health.observe(self.state["global_step"], metrics))
+            if self.lineage.enabled:
+                # training-outcome event: closes this index's provenance
+                # chain with what the update actually consumed
+                adv_arr = np.asarray(
+                    batch.get("advantages", scores_sel), dtype=np.float32
+                )
+                if adv_arr.ndim > 1:
+                    # per-token advantages (PPO/GAE): reduce to per-row means
+                    adv_arr = adv_arr.mean(axis=tuple(range(1, adv_arr.ndim)))
+                self.lineage.outcome(
+                    rollout_index, step=self.state["global_step"],
+                    policy_version=(orch.version if use_orch
+                                    else self.state["global_step"]),
+                    kept=int(local_bs),
+                    advantage=round(float(adv_arr.mean()), 6),
+                    scores=[round(float(s), 6)
+                            for s in np.asarray(log_scores).tolist()],
+                    eos_frac=round(float(contain_eos.mean()), 4),
+                    staleness=sample_staleness,
+                )
+                if self._use_is and agg.get("is_trunc_frac", 0.0) > 0:
+                    # truncated-IS rows stay IN the update with capped
+                    # weight — partial influence loss, attributed but not
+                    # excluded (`partial` marks it for the histogram reader)
+                    n_trunc = int(round(agg["is_trunc_frac"] * local_bs))
+                    if n_trunc:
+                        self.lineage.drop(
+                            rollout_index, "is_truncated_weight",
+                            count=n_trunc, step=self.state["global_step"],
+                            partial=True,
+                        )
+                for i, s in enumerate(
+                        np.asarray(log_scores).tolist()[:8]):
+                    self.lineage.note_sample(
+                        rollout_index, step=self.state["global_step"],
+                        score=round(float(s), 6),
+                        response_chars=len(responses_decoded[i])
+                        if i < len(responses_decoded) else None,
+                        kept=True,
+                    )
             if self.state["global_step"] % cfg.logging_steps == 0:
                 self.logger.log(self.state["global_step"], self.state["episode"], metrics)
+                sample_limit = (
+                    cfg.log_samples_limit
+                    if cfg.log_samples_limit is not None
+                    else cfg.num_printed_samples
+                )
                 self.logger.log_samples(
                     self.state["global_step"], question_strings, responses_decoded,
-                    log_scores, cfg.num_printed_samples,
+                    log_scores, sample_limit,
                 )
+                if self.lineage.enabled:
+                    # full-text sample records live here now, not in
+                    # metrics.jsonl (satellite: metrics stays numeric rows)
+                    for i, (q, r, s) in enumerate(zip(
+                            question_strings, responses_decoded,
+                            np.asarray(log_scores).tolist())):
+                        if i >= sample_limit:
+                            break
+                        self.lineage.event(
+                            "sample", rollout_index,
+                            step=self.state["global_step"], row=i,
+                            query=q, response=r, score=round(float(s), 6),
+                        )
 
             # ---- CHECKPOINT ------------------------------------------------
             saved_this_step = False
@@ -2137,7 +2266,11 @@ class RLTrainer:
                        # levels, verdict, trip counts — a resumed run keeps
                        # its learned baselines instead of re-warming and
                        # missing a collapse that started pre-restart
-                       "health": self.health.journal()}
+                       "health": self.health.journal(),
+                       # lineage journal: monotonic event index + drop
+                       # counters, so a resumed ledger appends to the
+                       # stream instead of restarting it
+                       "lineage": self.lineage.journal()}
         if orch is not None:
             # journal the queue: pending (dispatched, unconsumed)
             # indices + cumulative drop/staleness counters. Resume
@@ -2161,14 +2294,21 @@ class RLTrainer:
             value_params=self.value_params if cfg.save_value_model else None,
         )
 
-    def _dispatch_reward(self, prompts_and_responses, eos_token) -> np.ndarray:
+    def _dispatch_reward(self, prompts_and_responses, eos_token,
+                         rollout_index=None, step=None) -> np.ndarray:
         """Reward dispatch with the `reward.exec` injection point and a
         bounded retry: the reward callable is host-side (subprocess graders,
         RM inference) and a transient failure there must not kill a TPU
-        run mid-epoch."""
+        run mid-epoch. When `rollout_index` is passed, the lineage ledger
+        gets the per-sample scores, the retry attempt that finally landed,
+        and the grader wall time (backoff sleeps included — that IS the
+        step-time cost)."""
         from nanorlhf_tpu.resilience import retry_with_backoff
 
+        attempts_used = [0]
+
         def attempt():
+            attempts_used[0] += 1
             self.faults.fire("reward.exec")
             return np.asarray(
                 self.reward_func(prompts_and_responses, eos_token),
@@ -2181,10 +2321,19 @@ class RLTrainer:
         # when telemetry is off — one call site either way.
         with self.tracer.span("reward.dispatch", track="reward",
                               rows=len(prompts_and_responses)):
-            return retry_with_backoff(
+            t0 = time.perf_counter()
+            scores = retry_with_backoff(
                 attempt, attempts=self.cfg.reward_retries + 1,
                 backoff_base=0.1,
             )
+        if rollout_index is not None:
+            self.lineage.reward(
+                rollout_index, step=step,
+                scores=[round(float(s), 6) for s in scores.tolist()],
+                attempt=attempts_used[0],
+                wall_s=round(time.perf_counter() - t0, 6),
+            )
+        return scores
 
     def _sentinel_rollback(self, verdict: str, rollout_index: int):
         """Sentinel trip (docs/RESILIENCE.md): charge the rollback budget,
@@ -2309,6 +2458,12 @@ class RLTrainer:
         h = tstate.get("health")
         if h:
             self.health.restore(h)
+        # lineage journal: the resumed ledger continues the monotonic
+        # event-index stream and since-start drop counters (the files
+        # themselves were already re-opened append-mode at construction)
+        lj = tstate.get("lineage")
+        if lj:
+            self.lineage.restore(lj)
         self._reset_data_iterator()
         return self.state
 
@@ -2337,6 +2492,7 @@ class RLTrainer:
         # write the trace a crashed train() never reached
         self.profile_window.stop()
         self._write_trace()
+        self.lineage.close()  # flush the provenance ledger
         self.ckpt.close()  # flush any in-flight async checkpoint write
         self.logger.close()
         self._preemption.uninstall()  # restore the previous SIGTERM handler

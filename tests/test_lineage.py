@@ -1,0 +1,355 @@
+"""Sample lineage ledger (nanorlhf_tpu/telemetry/lineage.py,
+docs/OBSERVABILITY.md §6) — the tier-1 `lineage-smoke` CI gate:
+
+- the ledger rotates at max_bytes, keeps one monotonic event-index stream
+  across rotation AND resume, and `read_ledger` replays every event in
+  write order (tolerating a truncated tail);
+- deterministic per-index sampling gates WHOLE chains (never individual
+  events) while drop-reason counters stay exact;
+- `lineage/dropped_total{reason=...}` rows survive render_prometheus with
+  labels intact and pass the shared validate_prometheus_text check;
+- a 2-update GRPO run with cfg.lineage on yields a complete
+  lease→generation→reward→outcome chain for every consumed rollout index,
+  keeps full-text samples OUT of metrics.jsonl, journals "lineage" beside
+  "health" in trainer_state.json, and `tools/inspect_run.py --drops`
+  reproduces the drop histogram from the ledger alone;
+- the fleet path (2 workers, one injected worker.crash) adds queue-transit
+  events and stamps BOTH worker ids on the reassigned lease;
+- every sparse-GRPO-excluded row carries exactly one machine-readable
+  drop_reason.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from nanorlhf_tpu.telemetry import (
+    LineageLedger,
+    chains,
+    drop_histogram,
+    read_ledger,
+    render_prometheus,
+    validate_prometheus_text,
+)
+from nanorlhf_tpu.trainer import AlgoName
+
+from test_trainer_smoke import make_trainer
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "inspect_run.py")
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_rotation_and_monotonic_indices(tmp_path):
+    led = LineageLedger(str(tmp_path), max_bytes=2048)
+    for i in range(200):
+        led.event("generation", i, policy_version=i, blob="x" * 64)
+    led.close()
+    files = sorted(os.listdir(tmp_path / "lineage"))
+    assert len(files) > 1, "2048-byte cap must have rotated"
+    assert files[0] == "ledger_00000.jsonl"
+    events = list(read_ledger(str(tmp_path)))
+    assert len(events) == 200
+    idx = [ev["i"] for ev in events]
+    assert idx == sorted(idx) == list(range(200))  # monotonic, gapless
+
+
+def test_ledger_resume_appends_not_restarts(tmp_path):
+    led1 = LineageLedger(str(tmp_path), max_bytes=10**9)
+    for i in range(5):
+        led1.event("lease", i)
+    j = led1.journal()
+    led1.close()
+    # a fresh ledger in the same dir + restored journal continues the stream
+    led2 = LineageLedger(str(tmp_path), max_bytes=10**9)
+    led2.restore(j)
+    led2.event("lease", 5)
+    led2.close()
+    events = list(read_ledger(str(tmp_path)))
+    idx = [ev["i"] for ev in events]
+    assert idx == list(range(6))  # no restart at 0, no clobbered file
+
+
+def test_sampling_gates_whole_chains_but_counts_all_drops(tmp_path):
+    led = LineageLedger(str(tmp_path), sample_rate=0.5, rows_hint=4)
+    n_in = 0
+    for i in range(100):
+        a = led.event("lease", i)
+        b = led.event("outcome", i)
+        led.drop(i, "stale_drop")
+        # whole-chain property: both events share one gate decision
+        assert (a >= 0) == (b >= 0) == led.sampled(i)
+        n_in += a >= 0
+    assert 0 < n_in < 100  # the gate actually split the population
+    # counters are exact regardless of sampling; rows_hint denominates
+    assert led.drop_counts == {"stale_drop": 400}
+    # per-row drops count 1 each
+    led.drop(None, "sparse_zero_advantage", row=3)
+    assert led.drop_counts["sparse_zero_advantage"] == 1
+    led.close()
+    # disabled ledger: every call a no-op, nothing on disk
+    off = LineageLedger(str(tmp_path / "off"), enabled=False)
+    assert off.event("lease", 1) == -1
+    assert off.drop(1, "stale_drop") == -1
+    assert not os.path.exists(tmp_path / "off" / "lineage")
+
+
+def test_ledger_never_raises_after_close(tmp_path):
+    led = LineageLedger(str(tmp_path))
+    led.event("lease", 0)
+    led.close()
+    assert led.event("lease", 1) == -1  # counted, not raised
+    led.close()                         # idempotent
+
+
+def test_metric_rows_render_prometheus_labels(tmp_path):
+    led = LineageLedger(str(tmp_path))
+    led.drop(0, "sparse_zero_advantage", count=3)
+    led.drop(1, "fleet_late_duplicate")
+    text = render_prometheus({**led.metric_rows(), "perf/mfu": 0.41})
+    led.close()
+    validate_prometheus_text(text)
+    assert ('lineage_dropped_total{reason="sparse_zero_advantage"} 3'
+            in text)
+    assert 'lineage_dropped_total{reason="fleet_late_duplicate"} 1' in text
+    # one TYPE line for the labeled family, not one per label value
+    assert text.count("# TYPE nanorlhf_lineage_dropped_total gauge") == 1
+
+
+def test_drop_histogram_and_chains_readers(tmp_path):
+    led = LineageLedger(str(tmp_path))
+    led.lease(7, lease_id=1, worker_id=0, cursor=7, length=1)
+    led.generation(7, policy_version=2, worker_id=0)
+    led.drop(7, "keep_filter", count=2)
+    led.close()
+    events = list(read_ledger(str(tmp_path)))
+    assert drop_histogram(events) == {"keep_filter": 2}
+    by = chains(events)
+    assert set(by[7].keys()) == {"lease", "generation", "drop"}
+    # the segments schema hook: single-policy whole-range default
+    assert by[7]["generation"][0]["segments"] == [
+        {"policy_version": 2, "tok_range": [0, None]}
+    ]
+
+
+# ---------------------------------------------------------------------------
+# trainer integration (the lineage-smoke acceptance runs)
+# ---------------------------------------------------------------------------
+
+
+def _run_inspect(run_dir, *args):
+    out = subprocess.run(
+        [sys.executable, TOOLS, str(run_dir), *args, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow  # runs in the named lineage-smoke CI step
+def test_grpo_run_complete_chains_and_inspector(tmp_path):
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                      lineage=True)
+    tr.train()
+    statusz_drops = dict(tr.lineage.statusz()["drop_reasons"])
+    tr.close()
+    run_dir = tmp_path / "grpo"
+    events = list(read_ledger(str(run_dir)))
+    by_index = chains(events)
+    consumed = {ev["rollout_index"] for ev in events
+                if ev["type"] == "outcome"}
+    assert consumed, "2 updates must consume rollouts"
+    for idx in consumed:
+        # serial path: lease (stream dispatch) → generation → reward →
+        # outcome; no queue events without an orchestrator
+        for etype in ("lease", "generation", "reward", "outcome"):
+            assert etype in by_index[idx], (idx, sorted(by_index[idx]))
+        rwd = by_index[idx]["reward"][0]
+        assert rwd["attempt"] >= 1 and rwd["wall_s"] >= 0
+        assert rwd["scores"], "per-sample scores on the reward event"
+        assert by_index[idx]["lease"][0]["key_path"]  # PRNG fold-in path
+    # GRPO sample_n=2: keep-1-of-N drops every other completion
+    hist = drop_histogram(events)
+    assert hist.get("keep_filter", 0) > 0
+    # the inspector reproduces the histogram from the ledger alone, and it
+    # matches the live /statusz counters
+    assert _run_inspect(run_dir, "--drops")["drops"] == hist == statusz_drops
+    # --index renders a chain; --worst reads full-text sample events
+    some = sorted(consumed)[0]
+    assert "lease" in _run_inspect(run_dir, "--index", str(some))
+    worst = _run_inspect(run_dir, "--worst", "2")["worst"]
+    assert worst and all("response" in r for r in worst)
+    # satellite 1: metrics.jsonl carries ONLY metric rows — no full text
+    for line in open(run_dir / "metrics.jsonl"):
+        row = json.loads(line)
+        assert "query" not in row and "response" not in row
+    # full text went to the ledger instead
+    assert any(ev["type"] == "sample" and ev.get("response") is not None
+               for ev in events)
+
+
+@pytest.mark.slow  # runs in the named lineage-smoke CI step
+def test_lineage_journal_resumes_monotonic(tmp_path):
+    tr1 = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                       lineage=True)
+    tr1.train()
+    j1 = tr1.lineage.journal()
+    tr1.close()
+    assert j1["event_index"] > 0
+    # journaled beside "health" in trainer_state.json
+    tstate = tr1.ckpt.load_trainer_state(2)
+    assert "health" in tstate and tstate["lineage"]["event_index"] == \
+        j1["event_index"]
+    tr2 = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=64,
+                       lineage=True)
+    tr2.resume_from_checkpoint()
+    assert tr2.lineage.journal()["event_index"] == j1["event_index"]
+    tr2.train(num_updates=1)
+    tr2.close()
+    # one gapless monotonic stream across both processes
+    idx = [ev["i"] for ev in read_ledger(str(tmp_path / "grpo"))]
+    assert idx == sorted(idx) and len(idx) == len(set(idx))
+    assert max(idx) >= j1["event_index"]  # the resumed run appended
+
+
+@pytest.mark.slow  # runs in the named lineage-smoke CI step
+def test_fleet_crash_chains_and_reassigned_lease_worker_ids(tmp_path):
+    """ISSUE-9 acceptance: 2 rollout workers, one injected worker.crash —
+    every consumed index still has a complete lease→generation→queue→
+    reward→outcome chain, and the reassigned lease's event pair carries
+    both worker ids."""
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=48,
+                      save_steps=0, rollout_orchestrator=True,
+                      rollout_workers=2, max_staleness=0,
+                      fault_spec="worker.crash:at=1,worker=0",
+                      lineage=True)
+    tr.train()
+    tr.close()
+    run_dir = tmp_path / "grpo"
+    events = list(read_ledger(str(run_dir)))
+    by_index = chains(events)
+    consumed = {ev["rollout_index"] for ev in events
+                if ev["type"] == "outcome"}
+    assert consumed
+    for idx in consumed:
+        for etype in ("lease", "generation", "queue", "reward", "outcome"):
+            assert etype in by_index[idx], (idx, sorted(by_index[idx]))
+        q = by_index[idx]["queue"][0]
+        assert q["staleness"] == 0  # max_staleness=0 run
+    # the crashed lease was re-granted: the index's lease events carry the
+    # original worker AND the replacement
+    reassigned = [ev for ev in events if ev["type"] == "lease"
+                  and ev.get("reassigned_from") is not None]
+    assert reassigned, "worker.crash must produce a reassigned lease event"
+    ev = reassigned[0]
+    assert ev["reassigned_from"] == 0 and ev["worker_id"] != 0
+    first_grant = [
+        e for e in by_index[ev["rollout_index"]]["lease"]
+        if e.get("reassigned_from") is None
+    ]
+    assert first_grant and first_grant[0]["worker_id"] == 0
+    # inspector round-trip on the fleet ledger too
+    assert _run_inspect(run_dir, "--drops")["drops"] == \
+        drop_histogram(events)
+
+
+@pytest.mark.slow  # runs in the named lineage-smoke CI step
+def test_sparse_grpo_every_dropped_row_has_one_reason(tmp_path):
+    """The paper's silent zero-advantage skip, attributed: kept rows +
+    sparse-dropped rows partition each consumed batch, and no row carries
+    two drop reasons."""
+    import jax
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+    from nanorlhf_tpu.parallel import MeshConfig
+    from nanorlhf_tpu.trainer import RLConfig
+    from nanorlhf_tpu.trainer.sparse_grpo import SparseGRPOTrainer
+
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    dataset = load_prompt_dataset("synthetic:64", tok, max_prompt_len=12)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / "sparse"),
+        response_length=8, temperature=1.0, sample_n=2,
+        total_episodes=32, per_device_train_batch_size=1,
+        gradient_accumulation_steps=2, num_mini_batches=2,
+        num_ppo_epochs=1, learning_rate=1e-4, kl_coef=0.0,
+        use_lora=True, lora_r=4, lora_alpha=8,
+        gradient_checkpointing=False, mesh=MeshConfig(-1, 1, 1),
+        save_steps=1, report_to="jsonl", lineage=True,
+    )
+    rng = np.random.default_rng(0)
+    n = cfg.sample_n
+
+    def reward(pmt_and_responses, eos_token):
+        # even prompt groups score uniformly (zero group z-advantage →
+        # sparse-dropped); odd groups vary (kept)
+        out = np.zeros(len(pmt_and_responses), np.float32)
+        for i in range(len(out)):
+            g = i // n
+            out[i] = 0.5 if g % 2 == 0 else float(rng.random())
+        return out
+
+    tr = SparseGRPOTrainer(cfg, mcfg, tok, params, dataset, reward)
+    tr.train()
+    tr.close()
+    events = list(read_ledger(str(tmp_path / "sparse")))
+    by_index = chains(events)
+    outcomes = [ev for ev in events if ev["type"] == "outcome"]
+    assert outcomes, "varied odd groups must yield at least one update"
+    for out_ev in outcomes:
+        idx = out_ev["rollout_index"]
+        row_drops = [ev for ev in by_index[idx].get("drop", [])
+                     if ev.get("row") is not None]
+        rows = [ev["row"] for ev in row_drops]
+        # exactly one reason per dropped row
+        assert len(rows) == len(set(rows)), rows
+        assert all(ev["reason"] == "sparse_zero_advantage"
+                   for ev in row_drops)
+        # kept + dropped partition the post-keep batch
+        batch_rows = out_ev["kept"] + len(rows)
+        assert out_ev["kept"] >= 1 and len(rows) >= 1
+        # and keep-1-of-N dropped the other (n-1) completions per prompt
+        kf = [ev for ev in by_index[idx]["drop"]
+              if ev["reason"] == "keep_filter"]
+        assert sum(ev["count"] for ev in kf) == batch_rows * (n - 1)
+    # the statusz counter agrees with the ledger
+    hist = drop_histogram(events)
+    assert hist.get("sparse_zero_advantage", 0) >= 1
+
+
+@pytest.mark.slow  # runs in the named lineage-smoke CI step
+def test_statusz_serves_lineage_section(tmp_path):
+    import urllib.request
+
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                      lineage=True, status_port=-1)
+    tr.train()
+    port = tr.exporter.port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=5) as r:
+        statusz = json.loads(r.read().decode())
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        metrics_text = r.read().decode()
+    tr.close()
+    lin = statusz["lineage"]
+    assert lin["enabled"] and lin["events"] > 0
+    assert lin["drop_reasons"].get("keep_filter", 0) > 0
+    assert lin["recent"], "last-N sample ring must be populated"
+    validate_prometheus_text(metrics_text)
+    assert "lineage_events_total" in metrics_text
+    assert 'lineage_dropped_total{reason="keep_filter"}' in metrics_text

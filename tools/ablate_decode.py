@@ -154,7 +154,8 @@ def main():
                 "decode_tokens_per_sec": round(toks, 1),
             }
             if spec_stats:
-                st = {k: int(np.asarray(v)) for k, v in spec_stats[-1].items()}
+                st = {k: int(np.asarray(v)) for k, v in spec_stats[-1].items()
+                      if np.asarray(v).ndim == 0}  # accepted_rows is [B]
                 row["spec_acceptance"] = round(
                     st["accepted"] / max(st["drafted"], 1), 4
                 )

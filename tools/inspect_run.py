@@ -1,0 +1,221 @@
+"""Run inspector — the query side of the sample lineage ledger.
+
+Joins a run's provenance stream (`<run>/lineage/ledger_*.jsonl`, written by
+telemetry/lineage.py) back into per-sample stories: which worker and lease
+produced rollout K, what the grader scored it, how stale it was at
+consumption, and why any row left the batch. Works from the ledger ALONE —
+no live trainer, no metrics.jsonl required (though `--worst` will read
+scores from `sample`/`reward` events the ledger already carries).
+
+  python tools/inspect_run.py RUN_DIR                 # run overview
+  python tools/inspect_run.py RUN_DIR --drops         # drop-reason histogram
+  python tools/inspect_run.py RUN_DIR --worst 5       # N worst-reward samples,
+                                                      # full text + timeline
+  python tools/inspect_run.py RUN_DIR --index 42      # one rollout's chain:
+                                                      # lease→generation→queue
+                                                      # →reward→outcome
+  python tools/inspect_run.py RUN_DIR --drops --json  # machine-readable out
+
+RUN_DIR is the trainer's output_dir (containing `lineage/`) or the lineage
+directory itself. jax-free: runs anywhere the JSONL files can be read.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nanorlhf_tpu.telemetry.lineage import (  # noqa: E402
+    chains,
+    drop_histogram,
+    read_ledger,
+)
+
+
+def _fmt_time(ev, t0):
+    t = ev.get("time")
+    return f"+{t - t0:8.3f}s" if isinstance(t, (int, float)) else " " * 10
+
+
+def _chain_timeline(idx, by_type, t0):
+    """Render one rollout index's event chain in wall-clock order."""
+    lines = [f"rollout {idx}:"]
+    evs = sorted(
+        (ev for evl in by_type.values() for ev in evl),
+        key=lambda e: e.get("time", 0.0),
+    )
+    for ev in evs:
+        etype = ev["type"]
+        detail = ""
+        if etype == "lease":
+            who = f"worker {ev.get('worker_id')}"
+            if ev.get("reassigned_from") is not None:
+                who += f" (reassigned from worker {ev['reassigned_from']})"
+            detail = (f"lease {ev.get('lease_id')} -> {who}, "
+                      f"cursor {ev.get('cursor')}")
+            if ev.get("key_path"):
+                detail += f", key {ev['key_path']}"
+        elif etype == "generation":
+            detail = (f"policy v{ev.get('policy_version')} on worker "
+                      f"{ev.get('worker_id')}")
+            if ev.get("gen_s") is not None:
+                detail += f", {ev['gen_s']:.2f}s"
+            spec = ev.get("spec")
+            if spec:
+                detail += (f", spec acceptance "
+                           f"{spec.get('acceptance', '?')}")
+        elif etype == "queue":
+            wait = None
+            if ev.get("dequeue_t") and ev.get("enqueue_t"):
+                wait = ev["dequeue_t"] - ev["enqueue_t"]
+            detail = f"staleness {ev.get('staleness')}"
+            if wait is not None:
+                detail += f", queued {wait:.2f}s"
+        elif etype == "reward":
+            scores = ev.get("scores") or []
+            detail = (f"{len(scores)} scores, mean "
+                      f"{sum(scores) / max(len(scores), 1):.4f}, "
+                      f"attempt {ev.get('attempt')}, "
+                      f"grader {ev.get('wall_s', 0):.2f}s")
+        elif etype == "outcome":
+            detail = (f"step {ev.get('step')}: kept {ev.get('kept')} rows, "
+                      f"mean advantage {ev.get('advantage')}")
+        elif etype == "drop":
+            detail = f"DROP [{ev.get('reason')}] x{ev.get('count', 1)}"
+            if ev.get("row") is not None:
+                detail += f" (row {ev['row']})"
+        elif etype == "sample":
+            detail = (f"row {ev.get('row')} score {ev.get('score')} "
+                      f"({len(ev.get('response', ''))} chars)")
+        lines.append(f"  {_fmt_time(ev, t0)}  {etype:<10s} {detail}")
+    return "\n".join(lines)
+
+
+def _sample_rows(events):
+    """Per-row (index, row, score, query, response) from `sample` events —
+    the full-text records the trainer routes to the ledger (satellite 1);
+    falls back to per-score rows from `reward` events when a run logged no
+    sample text."""
+    rows = []
+    seen_text = False
+    for ev in events:
+        if ev.get("type") == "sample":
+            seen_text = True
+            rows.append({
+                "rollout_index": ev.get("rollout_index"),
+                "row": ev.get("row"),
+                "score": ev.get("score"),
+                "query": ev.get("query", ""),
+                "response": ev.get("response", ""),
+            })
+    if not seen_text:
+        for ev in events:
+            if ev.get("type") == "reward":
+                for i, s in enumerate(ev.get("scores") or []):
+                    rows.append({
+                        "rollout_index": ev.get("rollout_index"),
+                        "row": i, "score": s, "query": "", "response": "",
+                    })
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="inspect a run's sample-lineage ledger"
+    )
+    ap.add_argument("run_dir", help="run output dir (or its lineage/ dir)")
+    ap.add_argument("--drops", action="store_true",
+                    help="drop-reason histogram (samples per reason)")
+    ap.add_argument("--worst", type=int, metavar="N", default=0,
+                    help="N worst-reward samples with text + timeline")
+    ap.add_argument("--index", type=int, default=None,
+                    help="full event chain for one rollout index")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args()
+
+    events = list(read_ledger(args.run_dir))
+    if not events:
+        print(f"no ledger events under {args.run_dir} "
+              f"(is cfg.lineage on?)", file=sys.stderr)
+        return 1
+    by_index = chains(events)
+    t0 = min(ev.get("time", float("inf")) for ev in events)
+
+    if args.drops:
+        hist = drop_histogram(events)
+        if args.json:
+            print(json.dumps({"drops": hist}, sort_keys=True))
+        else:
+            print("drop-reason histogram (samples):")
+            for reason, count in sorted(
+                    hist.items(), key=lambda kv: -kv[1]):
+                print(f"  {reason:<24s} {count}")
+            if not hist:
+                print("  (no drops recorded)")
+        return 0
+
+    if args.index is not None:
+        by_type = by_index.get(args.index)
+        if by_type is None:
+            print(f"rollout index {args.index} not in ledger "
+                  f"(sampled out, or never consumed)", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(
+                {t: evs for t, evs in sorted(by_type.items())},
+                sort_keys=True,
+            ))
+        else:
+            print(_chain_timeline(args.index, by_type, t0))
+        return 0
+
+    if args.worst:
+        rows = [r for r in _sample_rows(events) if r["score"] is not None]
+        rows.sort(key=lambda r: r["score"])
+        rows = rows[: args.worst]
+        if args.json:
+            print(json.dumps({"worst": rows}))
+            return 0
+        for r in rows:
+            print("=" * 70)
+            print(f"rollout {r['rollout_index']} row {r['row']}  "
+                  f"score {r['score']}")
+            if r["query"]:
+                print(f"--- query ---\n{r['query']}")
+            if r["response"]:
+                print(f"--- response ---\n{r['response']}")
+            by_type = by_index.get(r["rollout_index"])
+            if by_type:
+                print("--- timeline ---")
+                print(_chain_timeline(r["rollout_index"], by_type, t0))
+        return 0
+
+    # default: run overview
+    n_by_type: dict = {}
+    for ev in events:
+        n_by_type[ev["type"]] = n_by_type.get(ev["type"], 0) + 1
+    hist = drop_histogram(events)
+    overview = {
+        "events": len(events),
+        "rollout_indices": len(by_index),
+        "by_type": n_by_type,
+        "drops": hist,
+    }
+    if args.json:
+        print(json.dumps(overview, sort_keys=True))
+        return 0
+    print(f"{len(events)} events across {len(by_index)} rollout indices")
+    for t, c in sorted(n_by_type.items()):
+        print(f"  {t:<10s} {c}")
+    if hist:
+        print("drops:")
+        for reason, count in sorted(hist.items(), key=lambda kv: -kv[1]):
+            print(f"  {reason:<24s} {count}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
