@@ -480,6 +480,131 @@ def _spec_decode_check(jax) -> dict:
     }
 
 
+def _paged_check(jax) -> dict:
+    """Paged-KV continuous-batching A/B on a LONG-TAIL synthetic corpus
+    (ISSUE 10, docs/PAGED_CACHE.md). Same deterministic Markov machine as
+    the spec check, extended with CHAIN states (v -> v+1 -> ... -> EOS) so
+    each prompt's greedy length is chosen by hand: a queue of mostly-short
+    chain rows plus a few max-length 4-cycle stragglers (the n-gram
+    drafter's best case, so spec_k pays on both sides). The queued paged
+    scheduler (decode_rows=R, pages recycled to waiting prompts mid-loop)
+    races the contiguous FIXED-BATCH schedule (waves of R, each wave
+    paying its longest row) at the same resident batch and spec_k=4 on
+    both sides. The ISSUE-10 acceptance gate: bit-identical greedy rows,
+    strictly fewer verify dispatches, higher tokens/s. Runs on every
+    backend (tiny model); gate with BENCH_PAGED=0."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+
+    V, R, resp, spec_k, P = 64, 4, 40, 4, 4
+    EOS, PAD = 3, 0
+    # wider than qwen2_tiny ON PURPOSE: the queued scheduler trades host
+    # syncs for fewer device dispatches, so the A/B only measures the
+    # mechanism when per-step compute dominates dispatch overhead (on a
+    # 64-wide model the CPU jit-call floor would swamp the win)
+    mcfg = dataclasses.replace(
+        ModelConfig.qwen2_tiny(vocab_size=V), tie_word_embeddings=False,
+        hidden_size=256, intermediate_size=512, num_hidden_layers=4,
+    )
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    sigma[[5, 6, 7, 8]] = [6, 7, 8, 5]                  # 4-cycle, no EOS
+    for t in range(10, 50):                             # chains -> EOS
+        sigma[t] = t + 1
+    sigma[50] = EOS
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    # start v emits min((50 - v) + 1, resp) tokens; 5/6 start the cycle
+    # (resp tokens, but HIGH spec acceptance). Queue order: the four
+    # length-40 chain stragglers first (they decode concurrently in the
+    # R=4 resident rows — non-repetitive, so spec can't compress them),
+    # then the short-chain/cycle tail backfills recycled rows. The fixed
+    # schedule is dealt ONE straggler per wave — each wave pays ~39
+    # dispatches for rows that mostly finished after 3.
+    starts = ([11, 11, 11, 11]
+              + [47, 48, 5, 47, 48, 46, 48, 6, 47, 48, 46, 47, 48, 46, 48, 47])
+    fixed_waves = [[0, 4, 5, 6], [1, 7, 8, 9], [2, 10, 11, 12],
+                   [3, 13, 14, 15], [16, 17, 18, 19]]
+    prompts = np.full((len(starts), 5), PAD, np.int32)
+    prompts[:, 3] = 9                                   # inert filler state
+    prompts[:, 4] = starts
+    ids, mask = jnp.asarray(prompts), jnp.asarray(prompts != PAD)
+    kw = dict(eos_token_id=EOS, pad_token_id=PAD)
+
+    def run_fixed():
+        out, stats = np.zeros((len(starts), resp), np.int32), []
+        for wave in fixed_waves:
+            st: list = []
+            idx = jnp.asarray(wave)
+            out[wave] = np.asarray(generate(
+                params, mcfg, ids[idx], mask[idx], jax.random.PRNGKey(0),
+                SamplingParams(greedy=True, max_tokens=resp, spec_k=spec_k),
+                spec_stats_out=st, **kw))
+            stats.append(st[-1])
+        return out, stats
+
+    def run_queued():
+        pst: list = []
+        out = np.asarray(generate(
+            params, mcfg, ids, mask, jax.random.PRNGKey(0),
+            SamplingParams(greedy=True, max_tokens=resp, spec_k=spec_k,
+                           page_size=P, decode_rows=R),
+            paged_stats_out=pst, **kw))
+        return out, pst[-1]
+
+    walls = {}
+    for name, fn in (("fixed", run_fixed), ("queued", run_queued)):
+        for rep in range(2):                            # compile + 1 timed
+            t0 = time.time()
+            out, stats = fn()
+            walls[name] = (out, stats, time.time() - t0)
+
+    out_f, stats_f, sec_f = walls["fixed"]
+    out_q, stats_q, sec_q = walls["queued"]
+    tokens = int((out_f != PAD).sum())
+    fixed_dispatches = sum(int(np.asarray(s["verify_steps"]))
+                           for s in stats_f)
+    queued_dispatches = int(np.asarray(stats_q["decode_iterations"]))
+    identical = bool(np.array_equal(out_f, out_q))
+    return {
+        "queue_length": len(starts),
+        "decode_rows": R,
+        "page_size": P,
+        "spec_k": spec_k,
+        "response_length": resp,
+        "tokens_emitted": tokens,
+        "page_utilization": round(
+            float(np.asarray(stats_q["page_utilization"])), 4),
+        "pages_recycled": int(np.asarray(stats_q["pages_recycled"])),
+        "admitted_midloop": int(np.asarray(stats_q["admitted_midloop"])),
+        "dispatch_steps_fixed": fixed_dispatches,
+        "dispatch_steps_queued": queued_dispatches,
+        "tokens_per_sec_fixed": round(tokens / sec_f, 1),
+        "tokens_per_sec_queued": round(tokens / sec_q, 1),
+        "sec_fixed": round(sec_f, 3),
+        "sec_queued": round(sec_q, 3),
+        "greedy_bit_identical": identical,
+        "paged_check": "ok" if (
+            identical and queued_dispatches < fixed_dispatches
+            and sec_q < sec_f
+        ) else "MISMATCH",
+    }
+
+
 def _flash_on_chip_check(jax) -> dict:
     import jax.numpy as jnp
 
@@ -1067,6 +1192,15 @@ def run_bench(jax, init_error):
         spec_decode_detail = _spec_decode_check(jax)
     except Exception as e:
         spec_decode_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    paged_detail = None
+    if os.environ.get("BENCH_PAGED", "1") == "1":
+        try:
+            # continuous-batching A/B (tiny model, any backend) — the
+            # ISSUE-10 gate: queued-paged beats fixed-batch tokens/s on a
+            # long-tail corpus with spec_k=4 on both sides, bit-identical
+            paged_detail = _paged_check(jax)
+        except Exception as e:
+            paged_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     detail = {
         "backend": backend,
@@ -1087,6 +1221,7 @@ def run_bench(jax, init_error):
         "sampler_logprob_capture": chosen["sampler_logprob_capture"],
         "kv_cache_quant": kv_cache_quant,
         "spec_decode": spec_decode_detail,
+        **({"paged": paged_detail} if paged_detail is not None else {}),
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

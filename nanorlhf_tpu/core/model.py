@@ -275,9 +275,79 @@ def _scale_update(cache, new, idx):
     return jax.lax.dynamic_update_slice(cache, new, (0, 0, 0, idx))
 
 
+# --------------------------------------------------------------------------- #
+# paged KV cache (ISSUE 10): a global page pool + per-row block table replaces
+# the per-row [T_max] slab — see docs/PAGED_CACHE.md and sampler/paged/
+# --------------------------------------------------------------------------- #
+
+def _paged_slots(cache_index, B, T):
+    """Logical cache slots [B, T] for a write of T tokens starting at
+    `cache_index` (scalar shared slot, or per-row [B] — speculative verify
+    and the continuous-batching scheduler advance rows at different rates)."""
+    idx = jnp.asarray(cache_index, jnp.int32)
+    if idx.ndim == 0:
+        idx = jnp.broadcast_to(idx, (B,))
+    return idx[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+
+def _paged_pages(pool, table, slots, page_size):
+    """Resolve logical slots [B, T] to (physical page, offset) pairs.
+    Out-of-table slots and sentinel table entries both map to page
+    `num_pages`, which `mode="drop"` scatters discard — a row past its page
+    budget (or with released pages) can never corrupt a live page."""
+    num_pages, nb = pool.shape[0], table.shape[1]
+    lb = slots // page_size
+    page = jnp.where(
+        lb < nb,
+        jnp.take_along_axis(table, jnp.clip(lb, 0, nb - 1), axis=1),
+        num_pages,
+    )
+    return page, slots % page_size
+
+
+def _paged_cache_update(pool, new, table, cache_index, page_size):
+    """Write `new` [B, KV, T, hd] through the block table into the page pool
+    [num_pages, KV, page_size, hd]."""
+    B, KV, T, hd = new.shape
+    page, off = _paged_pages(pool, table, _paged_slots(cache_index, B, T),
+                             page_size)
+    return pool.at[page, :, off, :].set(
+        new.transpose(0, 2, 1, 3), mode="drop")
+
+
+def _paged_scale_update(pool, new, table, cache_index, page_size):
+    """Same for the int8 scale pool [num_pages, KV, 8, page_size]
+    (offset on the LAST axis); `new` is [B, KV, 8, T]."""
+    B, KV, e, T = new.shape
+    page, off = _paged_pages(pool, table, _paged_slots(cache_index, B, T),
+                             page_size)
+    return pool.at[page, :, :, off].set(
+        new.transpose(0, 3, 1, 2), mode="drop")
+
+
+def _paged_view(pool, table, width):
+    """Gather a row-contiguous [B, KV, width, hd] cache view from the pool —
+    the off-TPU read path. Sentinel entries clamp to page num_pages-1; the
+    garbage they surface sits in slots the attention mask already excludes,
+    and NEG_INF masking zeroes its contribution exactly, so this view is
+    bit-identical to the contiguous cache under the same mask."""
+    num_pages = pool.shape[0]
+    g = pool[jnp.minimum(table, num_pages - 1)]      # [B, nb, KV, P, hd]
+    B, nb, KV, P, hd = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, KV, nb * P, hd)[:, :, :width, :]
+
+
+def _paged_scale_view(pool, table, width):
+    """[num_pages, KV, 8, P] scale pool → [B, KV, 8, width] view."""
+    num_pages = pool.shape[0]
+    g = pool[jnp.minimum(table, num_pages - 1)]      # [B, nb, KV, 8, P]
+    B, nb, KV, e, P = g.shape
+    return g.transpose(0, 2, 3, 1, 4).reshape(B, KV, e, nb * P)[..., :width]
+
+
 def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
                 cache_index, lora_layer=None, lora_scale=1.0, attn_fn=None,
-                decode_bounds=None, verify_bounds=None):
+                decode_bounds=None, verify_bounds=None, paged=None):
     """One decoder layer. If kv_cache is not None, operate incrementally.
 
     Returns (x_out, new_kv_pair_or_None).
@@ -291,6 +361,15 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
     (general masked XLA attention off-TPU / for the int8 cache, which
     dequantizes — correct, no bandwidth win; the single-token q8 kernel is
     unaffected).
+    `paged=(block_table [B, nb] int32, page_size)` switches the cache to the
+    paged layout (init_paged_kv_cache): writes scatter through the table
+    with `mode="drop"` (sentinel/over-budget slots discard), reads go to the
+    paged Pallas kernels on TPU or a gathered row-contiguous view sliced to
+    the mask width elsewhere — the view path reuses the exact same masked
+    gqa_attention math as the contiguous cache, which is what makes paged
+    generation bit-identical to contiguous on the CPU mesh (test-pinned).
+    The paged kernels skip the shard_map wrap (`_spmd_call` shards arg dim 0,
+    which for pools is pages, not batch); GSPMD partitions them instead.
     """
     hd = config.actual_head_dim
     H, KV = config.num_attention_heads, config.num_key_value_heads
@@ -316,20 +395,41 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
         kq_c, ks_c, vq_c, vs_c = kv_cache
         k_q, k_s = _quantize_kv(k)
         v_q, v_s = _quantize_kv(v)
-        kq_c = _cache_update(kq_c, k_q, cache_index)
-        vq_c = _cache_update(vq_c, v_q, cache_index)
-        ks_c = _scale_update(ks_c, k_s, cache_index)
-        vs_c = _scale_update(vs_c, v_s, cache_index)
+        if paged is not None:
+            table, psize = paged
+            kq_c = _paged_cache_update(kq_c, k_q, table, cache_index, psize)
+            vq_c = _paged_cache_update(vq_c, v_q, table, cache_index, psize)
+            ks_c = _paged_scale_update(ks_c, k_s, table, cache_index, psize)
+            vs_c = _paged_scale_update(vs_c, v_s, table, cache_index, psize)
+        else:
+            kq_c = _cache_update(kq_c, k_q, cache_index)
+            vq_c = _cache_update(vq_c, v_q, cache_index)
+            ks_c = _scale_update(ks_c, k_s, cache_index)
+            vs_c = _scale_update(vs_c, v_s, cache_index)
         new_cache = (kq_c, ks_c, vq_c, vs_c)
+
+        def _q8_views(width):
+            """Row-contiguous dequantized cache views (paged gathers through
+            the table; contiguous passes the slabs through)."""
+            if paged is not None:
+                return (
+                    _dequantize_kv(_paged_view(kq_c, paged[0], width),
+                                   _paged_scale_view(ks_c, paged[0], width),
+                                   q.dtype),
+                    _dequantize_kv(_paged_view(vq_c, paged[0], width),
+                                   _paged_scale_view(vs_c, paged[0], width),
+                                   q.dtype),
+                )
+            return (_dequantize_kv(kq_c, ks_c, q.dtype),
+                    _dequantize_kv(vq_c, vs_c, q.dtype))
+
         if verify_bounds is not None:
             # speculative verify over the int8 cache: dequantize and run the
             # general masked path — correct everywhere, no bandwidth win
             # (the q8 k-query kernel is future work; single-token decode
             # keeps the q8 kernel either way)
-            out = gqa_attention(
-                q, _dequantize_kv(kq_c, ks_c, q.dtype),
-                _dequantize_kv(vq_c, vs_c, q.dtype), mask,
-            )
+            kd, vd = _q8_views(mask.shape[-1])
+            out = gqa_attention(q, kd, vd, mask)
         elif T > 1 and use_flash(config.attention_impl, T):
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
                                 mask_is_causal_x_keyvalid=True, spmd=spmd)
@@ -341,47 +441,83 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             # natively — the whole point of the quantized cache.
             # attention_impl="xla" stays a working escape hatch (dequant
             # fallback below: correct, no bandwidth win)
-            from nanorlhf_tpu.ops.decode_attention import decode_attention_q8
-
             start, filled = decode_bounds
-            q8_args = (q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c, start, filled)
-            if spmd is not None:
-                out = _spmd_call(spmd, decode_attention_q8, q8_args,
-                                 (1, 1, 1, 1, 1, None, None))[:, :, None, :]
+            if paged is not None:
+                from nanorlhf_tpu.ops.decode_attention import (
+                    paged_decode_attention_q8,
+                )
+
+                out = paged_decode_attention_q8(
+                    q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c, paged[0],
+                    start, filled,
+                )[:, :, None, :]
             else:
-                out = decode_attention_q8(*q8_args)[:, :, None, :]
+                from nanorlhf_tpu.ops.decode_attention import (
+                    decode_attention_q8,
+                )
+
+                q8_args = (q[:, :, 0, :], kq_c, ks_c, vq_c, vs_c, start,
+                           filled)
+                if spmd is not None:
+                    out = _spmd_call(spmd, decode_attention_q8, q8_args,
+                                     (1, 1, 1, 1, 1, None, None))[:, :, None, :]
+                else:
+                    out = decode_attention_q8(*q8_args)[:, :, None, :]
         else:
             # correctness fallback (CPU tests): dequantize and reuse the
             # exact path — no bandwidth win off-TPU, none needed
-            out = gqa_attention(
-                q, _dequantize_kv(kq_c, ks_c, q.dtype),
-                _dequantize_kv(vq_c, vs_c, q.dtype), mask,
-            )
+            kd, vd = _q8_views(mask.shape[-1])
+            out = gqa_attention(q, kd, vd, mask)
     elif kv_cache is not None:
         k_cache, v_cache = kv_cache
-        k_cache = _cache_update(k_cache, k, cache_index)
-        v_cache = _cache_update(v_cache, v, cache_index)
+        if paged is not None:
+            table, psize = paged
+            k_cache = _paged_cache_update(k_cache, k, table, cache_index, psize)
+            v_cache = _paged_cache_update(v_cache, v, table, cache_index, psize)
+            # logical cache length (for the kernel-eligibility threshold and
+            # the gathered view) is the mask width, not the pool shape
+            cache_len = mask.shape[-1]
+        else:
+            k_cache = _cache_update(k_cache, k, cache_index)
+            v_cache = _cache_update(v_cache, v, cache_index)
+            cache_len = k_cache.shape[2]
         new_cache = (k_cache, v_cache)
+
+        def _kv_views(width):
+            if paged is not None:
+                return (_paged_view(k_cache, paged[0], width),
+                        _paged_view(v_cache, paged[0], width))
+            return k_cache, v_cache
+
         if verify_bounds is not None:
             # speculative verify: T = k+1 candidate queries read the cache
             # (their KV just landed at per-row slots [fill, fill+T)). The
             # k-query prefix-bounded kernel on TPU; the general masked XLA
             # contraction elsewhere (mask carries prefix + causal-within-
             # candidates, built by decode_verify).
-            if use_decode_kernel(config.attention_impl, k_cache.shape[2]):
-                from nanorlhf_tpu.ops.decode_attention import (
-                    decode_verify_attention,
-                )
-
+            if use_decode_kernel(config.attention_impl, cache_len):
                 start, vfill = verify_bounds
-                ver_args = (q, k_cache, v_cache, start, vfill)
-                if spmd is not None:
-                    out = _spmd_call(spmd, decode_verify_attention, ver_args,
-                                     (1, 1, 1, None, None))
+                if paged is not None:
+                    from nanorlhf_tpu.ops.decode_attention import (
+                        paged_decode_verify_attention,
+                    )
+
+                    out = paged_decode_verify_attention(
+                        q, k_cache, v_cache, paged[0], start, vfill)
                 else:
-                    out = decode_verify_attention(*ver_args)
+                    from nanorlhf_tpu.ops.decode_attention import (
+                        decode_verify_attention,
+                    )
+
+                    ver_args = (q, k_cache, v_cache, start, vfill)
+                    if spmd is not None:
+                        out = _spmd_call(spmd, decode_verify_attention,
+                                         ver_args, (1, 1, 1, None, None))
+                    else:
+                        out = decode_verify_attention(*ver_args)
             else:
-                out = gqa_attention(q, k_cache, v_cache, mask)
+                kd, vd = _kv_views(mask.shape[-1])
+                out = gqa_attention(q, kd, vd, mask)
         elif T > 1 and use_flash(config.attention_impl, T):
             # prefill: cache slots beyond T are masked anyway, so attend over
             # the local-length K/V through the flash kernel instead of the
@@ -389,19 +525,29 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
             out = gqa_attention(q, k, v, mask[..., :T], impl="pallas",
                                 mask_is_causal_x_keyvalid=True, spmd=spmd)
         elif (T == 1 and decode_bounds is not None
-              and use_decode_kernel(config.attention_impl, k_cache.shape[2])):
+              and use_decode_kernel(config.attention_impl, cache_len)):
             # decode: prefix-bounded Pallas kernel reads only the filled
             # cache range instead of the masked T_max square
-            from nanorlhf_tpu.ops.decode_attention import decode_attention
+            if paged is not None:
+                from nanorlhf_tpu.ops.decode_attention import (
+                    paged_decode_attention,
+                )
 
-            dec_args = (q[:, :, 0, :], k_cache, v_cache) + tuple(decode_bounds)
-            if spmd is not None:
-                out = _spmd_call(spmd, decode_attention, dec_args,
-                                 (1, 1, 1, None, None))[:, :, None, :]
+                out = paged_decode_attention(
+                    q[:, :, 0, :], k_cache, v_cache, paged[0],
+                    *decode_bounds)[:, :, None, :]
             else:
-                out = decode_attention(*dec_args)[:, :, None, :]
+                from nanorlhf_tpu.ops.decode_attention import decode_attention
+
+                dec_args = (q[:, :, 0, :], k_cache, v_cache) + tuple(decode_bounds)
+                if spmd is not None:
+                    out = _spmd_call(spmd, decode_attention, dec_args,
+                                     (1, 1, 1, None, None))[:, :, None, :]
+                else:
+                    out = decode_attention(*dec_args)[:, :, None, :]
         else:
-            out = gqa_attention(q, k_cache, v_cache, mask)
+            kd, vd = _kv_views(mask.shape[-1])
+            out = gqa_attention(q, kd, vd, mask)
     else:
         new_cache = None
         out = gqa_attention(q, k, v, mask, impl=config.attention_impl,
@@ -423,7 +569,7 @@ def _layer_body(config: ModelConfig, x, layer_params, cos, sin, mask, kv_cache,
 
 def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0,
                 lora_scale=1.0, remat=False, attn_fn=None, layer_transform=None,
-                decode_bounds=None, verify_bounds=None):
+                decode_bounds=None, verify_bounds=None, paged=None):
     """Scan the stacked layer params over the layer body.
 
     `remat=True` wraps the body in jax.checkpoint — the training path's
@@ -466,13 +612,16 @@ def _run_layers(config, params, x, cos, sin, mask, kv_caches=None, cache_index=0
         return x, None
     else:
         # cache is a tuple of stacked arrays: (k, v) exact, or
-        # (k_q, k_s, v_q, v_s) int8 — threaded generically through the scan
+        # (k_q, k_s, v_q, v_s) int8 — threaded generically through the scan.
+        # `paged` (block table + page size) is closure-captured, not scanned:
+        # one table serves every layer
         def body(carry, inp):
             layer_params, lora_layer = inp[0], inp[1]
             y, new_cache = _layer_body(
                 config, carry, layer_params, cos, sin, mask, tuple(inp[2:]),
                 cache_index, lora_layer, lora_scale,
                 decode_bounds=decode_bounds, verify_bounds=verify_bounds,
+                paged=paged,
             )
             return y, new_cache
 
@@ -696,6 +845,38 @@ def init_kv_cache(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def init_paged_kv_cache(
+    config: ModelConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> tuple[jnp.ndarray, ...]:
+    """Paged KV cache: a global page pool shared by every row, addressed
+    through a per-row block table (sampler/paged/pages.py).
+
+    Exact: (k, v), each [L, num_pages, KV, page_size, hd].
+    kv_cache_quant="int8": (k_q, k_s, v_q, v_s) with scale pools
+    [L, num_pages, KV, 8, page_size] — the sublane-expanded layout of
+    `init_kv_cache`, per page instead of per row.
+
+    Same tuple arity as the contiguous cache, so `_run_layers` threads it
+    through the layer scan unchanged; the block table is NOT part of the
+    cache tuple (it is shared across layers and rides as a separate
+    argument).
+    """
+    shape = (
+        config.num_hidden_layers,
+        num_pages,
+        config.num_key_value_heads,
+        page_size,
+        config.actual_head_dim,
+    )
+    if config.kv_cache_quant == "int8":
+        sshape = shape[:3] + (8, page_size)
+        return (
+            jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.bfloat16),
+            jnp.zeros(shape, jnp.int8), jnp.ones(sshape, jnp.bfloat16),
+        )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
 def _quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """[B, KV, T, hd] -> (int8 [B, KV, T, hd], bf16 scales [B, KV, 8, T]).
 
@@ -731,6 +912,12 @@ def prefill(
     attention_mask: jnp.ndarray,  # [B, T_prompt]
     kv_caches: tuple[jnp.ndarray, jnp.ndarray],  # from init_kv_cache, T_max >= T_prompt
     lora_scale: float = 1.0,
+    page_table=None,              # [B, nb] int32 (paged layout; see init_paged_kv_cache)
+    page_size: int = 0,
+    logical_len: int = 0,         # paged: the logical cache width T_max (mask
+                                  # width must match the contiguous run
+                                  # bit-for-bit, so it cannot be inferred
+                                  # from the pool shape)
 ):
     """Prompt ingestion: fills the KV cache, returns (last-position logits, caches).
 
@@ -738,7 +925,12 @@ def prefill(
     the last position is the last prompt token for every row.
     """
     B, T = input_ids.shape
-    T_max = kv_caches[0].shape[3]
+    paged = None
+    if page_table is not None:
+        T_max = logical_len if logical_len else page_table.shape[1] * page_size
+        paged = (page_table, page_size)
+    else:
+        T_max = kv_caches[0].shape[3]
     attention_mask = attention_mask.astype(bool)
     position_ids = jnp.cumsum(attention_mask, axis=1) - attention_mask.astype(jnp.int32)
     x = params["embed_tokens"][jnp.where(attention_mask, input_ids, 0)].astype(
@@ -751,7 +943,7 @@ def prefill(
     mask_full = jnp.zeros((B, 1, T, T_max), bool).at[:, :, :, :T].set(mask)
     x, new_caches = _run_layers(
         config, params, x, cos, sin, mask_full, kv_caches=kv_caches, cache_index=0,
-        lora_scale=lora_scale,
+        lora_scale=lora_scale, paged=paged,
     )
     logits = _logits(config, params, x[:, -1:, :])[:, 0, :]
     return logits, new_caches
@@ -762,13 +954,18 @@ def decode_step(
     config: ModelConfig,
     token: jnp.ndarray,           # [B] current token
     position: jnp.ndarray,        # [B] its absolute position id
-    cache_index,                  # scalar: slot to write KV into
+    cache_index,                  # slot to write KV into: scalar, or per-row
+                                  # [B] (continuous-batching rows advance at
+                                  # different rates)
     key_mask: jnp.ndarray,        # [B, T_max] bool: which cache slots are valid (incl. this one)
     kv_caches: tuple[jnp.ndarray, jnp.ndarray],
     lora_scale: float = 1.0,
+    page_table=None,              # [B, nb] int32 (paged layout)
+    page_size: int = 0,
 ):
     """One autoregressive decode step. Returns (logits [B, V], new caches)."""
     B = token.shape[0]
+    paged = (page_table, page_size) if page_table is not None else None
     x = params["embed_tokens"][token][:, None, :].astype(params["embed_tokens"].dtype)
     cos, sin = rope_tables(position[:, None], config.actual_head_dim, config.rope_theta)
     mask = key_mask[:, None, None, :]  # [B, 1, 1, T_max]
@@ -776,10 +973,11 @@ def decode_step(
     # left-pad offset up to the slot just written (sampler sets it True before
     # the call) — the bounds the prefix-reading Pallas decode kernel needs
     start = jnp.argmax(key_mask, axis=1).astype(jnp.int32)
-    filled = jnp.full((B,), cache_index + 1, jnp.int32)
+    filled = jnp.broadcast_to(
+        jnp.asarray(cache_index, jnp.int32) + 1, (B,))
     x, new_caches = _run_layers(
         config, params, x, cos, sin, mask, kv_caches=kv_caches, cache_index=cache_index,
-        lora_scale=lora_scale, decode_bounds=(start, filled),
+        lora_scale=lora_scale, decode_bounds=(start, filled), paged=paged,
     )
     logits = _logits(config, params, x)[:, 0, :]
     return logits, new_caches
@@ -795,6 +993,8 @@ def decode_verify(
                                   # (excludes the candidate slots)
     kv_caches: tuple[jnp.ndarray, ...],
     lora_scale: float = 1.0,
+    page_table=None,              # [B, nb] int32 (paged layout)
+    page_size: int = 0,
 ):
     """Batched k-token verification for speculative decode
     (sampler/speculative.py): one small-T causal forward over Tq = k+1
@@ -804,13 +1004,20 @@ def decode_verify(
     [fill, fill+Tq) (accepted rows advance at different rates, hence the
     [B]-shaped slot index); query i attends to `key_mask` plus candidates
     0..i. Rejected candidates leave garbage KV in slots the caller never
-    marks valid — the next verify overwrites them. Returns
+    marks valid — the next verify overwrites them. On the paged layout a
+    candidate write may straddle two pages; the generic table-routed scatter
+    handles that, and writes past the row's page budget drop (those
+    candidates are beyond `max_tokens` and are truncated before emission —
+    docs/PAGED_CACHE.md walks the bound). Returns
     (logits [B, Tq, V], new caches): logits[:, i] is the next-token
     distribution after consuming candidates 0..i, bit-matching a chain of
     `decode_step` calls over the same tokens on the CPU mesh (test-pinned).
     """
     B, Tq = tokens.shape
-    T_max = kv_caches[0].shape[3]
+    # the logical width is the key_mask width — equal to the slab's T_max on
+    # the contiguous layout, and the only meaningful width on the paged one
+    T_max = key_mask.shape[1]
+    paged = (page_table, page_size) if page_table is not None else None
     key_mask = key_mask.astype(bool)
     x = params["embed_tokens"][tokens].astype(params["embed_tokens"].dtype)
     cos, sin = rope_tables(positions, config.actual_head_dim, config.rope_theta)
@@ -822,6 +1029,6 @@ def decode_verify(
     x, new_caches = _run_layers(
         config, params, x, cos, sin, mask, kv_caches=kv_caches,
         cache_index=fill.astype(jnp.int32), lora_scale=lora_scale,
-        verify_bounds=(start, fill.astype(jnp.int32)),
+        verify_bounds=(start, fill.astype(jnp.int32)), paged=paged,
     )
     return _logits(config, params, x), new_caches

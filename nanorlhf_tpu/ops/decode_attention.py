@@ -467,3 +467,278 @@ def decode_attention(
         interpret=_interpret_default() if interpret is None else interpret,
     )(start.astype(jnp.int32), filled.astype(jnp.int32), qg, k_cache, v_cache)
     return out[:, :, :G, :].reshape(B, H, hd)
+
+
+# --------------------------------------------------------------------------- #
+# paged variants (ISSUE 10): K/V live in a global page pool and are gathered
+# through a per-row block table instead of sitting in a per-row slab
+# --------------------------------------------------------------------------- #
+#
+# Pool layout (core/model.py:init_paged_kv_cache, per layer): [num_pages, KV,
+# page_size, hd]; block table: [B, n_blocks] int32 mapping logical block j of
+# row b to a physical page (sentinel num_pages = unallocated, clamped here).
+# The kernel bodies are UNCHANGED — positions are logical (`actual_j * block_k
+# + iota` with block_k = page_size), only the BlockSpec index maps change: the
+# table rides along as a third scalar-prefetch operand and the kv index map
+# resolves logical block → physical page before the DMA is issued. The same
+# clamp-to-last-valid-block trick applies, so revisited blocks still skip the
+# re-fetch and HBM traffic stays proportional to the filled prefix.
+#
+# NOTE on tiling: block_k here is the page size, so the pool's (page_size, hd)
+# trailing dims must satisfy the dtype's min tile — page_size ≥ 8 for f32,
+# ≥ 16 for bf16, and the int8 scale block (1, 1, 8, page_size) wants
+# page_size ≥ 128 lanes on real hardware. CPU tests run in interpret mode
+# where any page size works; pick page_size ≥ 128 for compiled TPU runs.
+
+
+def _gather_pool(pool, table):
+    """[N, KV, P, hd] pool + [B, nb] table → contiguous [B, KV, nb*P, hd]
+    view (sentinel entries clamp to page N-1; callers mask those slots)."""
+    N = pool.shape[0]
+    g = pool[jnp.minimum(table, N - 1)]          # [B, nb, KV, P, hd]
+    B, nb, KV, P, hd = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(B, KV, nb * P, hd)
+
+
+def _gather_scale_pool(spool, table):
+    """[N, KV, 8, P] scale pool + [B, nb] table → [B, KV, 8, nb*P] view."""
+    N = spool.shape[0]
+    g = spool[jnp.minimum(table, N - 1)]         # [B, nb, KV, 8, P]
+    B, nb, KV, e, P = g.shape
+    return g.transpose(0, 2, 3, 1, 4).reshape(B, KV, e, nb * P)
+
+
+def reference_paged_decode_attention(q, k_pool, v_pool, table, start, filled):
+    """XLA oracle for `paged_decode_attention`: gather pages to a contiguous
+    per-row view, then the exact reference. q: [B, H, hd]; pools:
+    [N, KV, P, hd]; table: [B, nb] int32."""
+    return reference_decode_attention(
+        q, _gather_pool(k_pool, table), _gather_pool(v_pool, table),
+        start, filled)
+
+
+def reference_paged_decode_attention_q8(q, kq_pool, ks_pool, vq_pool, vs_pool,
+                                        table, start, filled):
+    """int8 oracle: gather quant + scale pools, dequantize, exact reference."""
+    return reference_decode_attention_q8(
+        q, _gather_pool(kq_pool, table), _gather_scale_pool(ks_pool, table),
+        _gather_pool(vq_pool, table), _gather_scale_pool(vs_pool, table),
+        start, filled)
+
+
+def reference_paged_decode_verify_attention(q, k_pool, v_pool, table, start,
+                                            fill):
+    """k-query (speculative verify) oracle over pages."""
+    return reference_decode_verify_attention(
+        q, _gather_pool(k_pool, table), _gather_pool(v_pool, table),
+        start, fill)
+
+
+def _paged_decode_kernel(start_ref, filled_ref, table_ref, q_ref, k_ref,
+                         v_ref, o_ref, acc_ref, m_ref, l_ref,
+                         *, scale: float, block_k: int):
+    # the table is consumed by the index maps only — the body is identical
+    del table_ref
+    _decode_kernel(start_ref, filled_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, scale=scale, block_k=block_k)
+
+
+def _paged_decode_q8_kernel(start_ref, filled_ref, table_ref, q_ref, kq_ref,
+                            ks_ref, vq_ref, vs_ref, o_ref, acc_ref, m_ref,
+                            l_ref, *, scale: float, block_k: int):
+    del table_ref
+    _decode_q8_kernel(start_ref, filled_ref, q_ref, kq_ref, ks_ref, vq_ref,
+                      vs_ref, o_ref, acc_ref, m_ref, l_ref, scale=scale,
+                      block_k=block_k)
+
+
+def _paged_verify_kernel(start_ref, fill_ref, table_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_ref, m_ref, l_ref, *, scale: float,
+                         block_k: int, Tq: int):
+    del table_ref
+    _verify_kernel(start_ref, fill_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                   m_ref, l_ref, scale=scale, block_k=block_k, Tq=Tq)
+
+
+def _paged_kv_index_map(num_pages, page_size, last_offset=-1):
+    """Logical block → physical page index map for pool operands. The clamp
+    chain: logical block clamps to the last valid block (revisit
+    optimization, same as the contiguous kernels), then the table lookup
+    clamps the sentinel `num_pages` to a real page (rows with released pages
+    produce garbage that the caller discards — their writes were dropped and
+    their outputs are masked).
+
+    `last_offset`: the last readable slot relative to the prefetched bound —
+    decode passes `filled` (one past the last slot, offset -1); verify
+    passes `fill` (slot of candidate 0, offset Tq - 1)."""
+    def kv_index_map(b, kv, j, start_ref, filled_ref, table_ref):
+        first = start_ref[b] // page_size
+        last = jnp.maximum((filled_ref[b] + last_offset) // page_size, 0)
+        lb = jnp.minimum(first + j, last)
+        page = jnp.minimum(table_ref[b, lb], num_pages - 1)
+        return (page, kv, 0, 0)
+    return kv_index_map
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,       # [B, H, hd] — single decode position
+    k_pool: jnp.ndarray,  # [N, KV, P, hd] global page pool
+    v_pool: jnp.ndarray,  # [N, KV, P, hd]
+    table: jnp.ndarray,   # [B, nb] int32 block table (sentinel = N)
+    start: jnp.ndarray,   # [B] int32: first valid logical slot
+    filled: jnp.ndarray,  # [B] int32: one past the last valid logical slot
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Prefix-bounded decode attention over the paged KV cache: the grid
+    walks logical blocks [start//P, (filled-1)//P] and the index map routes
+    each through the block table, so a row's pages may be scattered anywhere
+    in the pool. Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    N, KV, P, _ = k_pool.shape
+    nb = table.shape[1]
+    G = H // KV
+    Gp = max(8, G)
+
+    qg = q.reshape(B, KV, G, hd)
+    if Gp != G:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Gp - G), (0, 0)])
+
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=1.0 / (hd ** 0.5), block_k=P
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, hd),
+                         lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P)),
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Gp, hd),
+                               lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Gp, hd), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Gp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), filled.astype(jnp.int32),
+      table.astype(jnp.int32), qg, k_pool, v_pool)
+    return out[:, :, :G, :].reshape(B, H, hd)
+
+
+def paged_decode_attention_q8(
+    q: jnp.ndarray,        # [B, H, hd]
+    kq_pool: jnp.ndarray,  # [N, KV, P, hd] int8
+    ks_pool: jnp.ndarray,  # [N, KV, 8, P] bf16 sublane-expanded scales
+    vq_pool: jnp.ndarray,  # [N, KV, P, hd] int8
+    vs_pool: jnp.ndarray,  # [N, KV, 8, P] bf16
+    table: jnp.ndarray,    # [B, nb] int32
+    start: jnp.ndarray,    # [B] int32
+    filled: jnp.ndarray,   # [B] int32
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """int8-pool variant of `paged_decode_attention` (same folded-scale math
+    as `decode_attention_q8`). Returns [B, H, hd]."""
+    B, H, hd = q.shape
+    N, KV, P, _ = kq_pool.shape
+    nb = table.shape[1]
+    G = H // KV
+    Gp = max(8, G)
+
+    qg = q.reshape(B, KV, G, hd)
+    if Gp != G:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Gp - G), (0, 0)])
+
+    kernel = functools.partial(
+        _paged_decode_q8_kernel, scale=1.0 / (hd ** 0.5), block_k=P
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, hd),
+                         lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+            # the scale block (1, 1, 8, P) shares the kv index map — both
+            # resolve to (page, kv, 0, 0)
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P)),
+            pl.BlockSpec((1, 1, 8, P), _paged_kv_index_map(N, P)),
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P)),
+            pl.BlockSpec((1, 1, 8, P), _paged_kv_index_map(N, P)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Gp, hd),
+                               lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Gp, hd), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+            pltpu.VMEM((Gp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Gp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), filled.astype(jnp.int32),
+      table.astype(jnp.int32), qg, kq_pool, ks_pool, vq_pool, vs_pool)
+    return out[:, :, :G, :].reshape(B, H, hd)
+
+
+def paged_decode_verify_attention(
+    q: jnp.ndarray,       # [B, H, Tq, hd] — k+1 candidate positions
+    k_pool: jnp.ndarray,  # [N, KV, P, hd] (candidate KV already written)
+    v_pool: jnp.ndarray,  # [N, KV, P, hd]
+    table: jnp.ndarray,   # [B, nb] int32
+    start: jnp.ndarray,   # [B] int32
+    fill: jnp.ndarray,    # [B] int32: slot of candidate 0
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Paged k-query verify attention — `decode_verify_attention` with the
+    kv stream routed through the block table. The grid covers logical blocks
+    up to (fill + Tq - 1)//P so candidate writes straddling a page boundary
+    are both visited. Returns [B, H, Tq, hd]."""
+    B, H, Tq, hd = q.shape
+    N, KV, P, _ = k_pool.shape
+    nb = table.shape[1]
+    G = H // KV
+    R = G * Tq
+    Rp = 8 * pl.cdiv(R, 8)
+
+    qg = q.reshape(B, KV, G, Tq, hd).reshape(B, KV, R, hd)
+    if Rp != R:
+        qg = jnp.pad(qg, [(0, 0), (0, 0), (0, Rp - R), (0, 0)])
+
+    kernel = functools.partial(
+        _paged_verify_kernel, scale=1.0 / (hd ** 0.5), block_k=P, Tq=Tq
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KV, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, Rp, hd),
+                         lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P, last_offset=Tq - 1)),
+            pl.BlockSpec((1, 1, P, hd), _paged_kv_index_map(N, P, last_offset=Tq - 1)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Rp, hd),
+                               lambda b, kv, j, s, f, t: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Rp, hd), jnp.float32),
+            pltpu.VMEM((Rp, 128), jnp.float32),
+            pltpu.VMEM((Rp, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, Rp, hd), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), fill.astype(jnp.int32),
+      table.astype(jnp.int32), qg, k_pool, v_pool)
+    return out[:, :, :R, :].reshape(B, KV, G, Tq, hd).reshape(B, H, Tq, hd)

@@ -3,4 +3,13 @@ from nanorlhf_tpu.sampler.speculative import generate_tokens_spec
 
 __all__ = [
     "SamplingParams", "generate", "generate_tokens", "generate_tokens_spec",
+    "generate_tokens_queued",
 ]
+
+
+def __getattr__(name):
+    # lazy: the paged scheduler imports back into sampler.py at call time
+    if name == "generate_tokens_queued":
+        from nanorlhf_tpu.sampler.paged.scheduler import generate_tokens_queued
+        return generate_tokens_queued
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,4 +1,12 @@
-"""Compacting decode: the TPU-idiomatic analogue of vLLM's continuous batching.
+"""Compacting decode — LEGACY, contiguous-cache-layout only.
+
+DEPRECATED in favor of the paged KV cache: `SamplingParams.page_size > 0`
+with `decode_rows > 0` (sampler/paged/, docs/PAGED_CACHE.md) recycles
+finished rows' cache pages to QUEUED prompts mid-loop — true continuous
+batching rather than this module's batch-shrink approximation — and, unlike
+this path, composes with speculative decode. This module stays for the
+contiguous layout (it gathers per-row [T_max] cache slabs, which the paged
+pool doesn't have; `generate` raises on page_size > 0 + compaction).
 
 The monolithic decode loop (`sampler.generate_tokens`) runs until EVERY row
 has emitted EOS — each straggler drags the whole batch through full-batch
@@ -30,9 +38,11 @@ sampler/speculative.py): MUTUALLY EXCLUSIVE — the row gather above moves
 KV caches without touching slot layout precisely because all live rows
 share the same step alignment (row r's token t always sits in slot Tp+t),
 while speculative accept lengths advance rows at different rates and break
-that invariant. `generate` raises on the combination; pick compaction for
-straggler-dominated length distributions, spec_k for self-repetitive
-corpora.
+that invariant. `generate` raises on the combination. The paged scheduler
+has no such restriction — per-row fill is native there — so
+straggler-dominated AND self-repetitive corpora both route through
+`page_size` + `decode_rows` (+ `spec_k`); reach for this module only when
+the contiguous layout itself is required.
 """
 
 from __future__ import annotations

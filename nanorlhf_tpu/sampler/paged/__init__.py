@@ -1,0 +1,18 @@
+from nanorlhf_tpu.sampler.paged.pages import (
+    PageState, init_page_state, alloc_row, release_row, full_table,
+    blocks_per_row,
+)
+
+__all__ = [
+    "PageState", "init_page_state", "alloc_row", "release_row", "full_table",
+    "blocks_per_row", "generate_tokens_queued",
+]
+
+
+def __getattr__(name):
+    # lazy: scheduler imports sampler.sampler, which imports pages through
+    # this package — an eager scheduler import here would close the cycle
+    if name == "generate_tokens_queued":
+        from nanorlhf_tpu.sampler.paged.scheduler import generate_tokens_queued
+        return generate_tokens_queued
+    raise AttributeError(name)

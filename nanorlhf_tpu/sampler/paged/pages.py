@@ -1,0 +1,111 @@
+"""Page allocator + per-row block tables for the paged KV cache.
+
+The paged layout replaces the per-row contiguous `[T_max]` cache slab with a
+global pool of fixed-size pages (`core/model.py:init_paged_kv_cache`,
+`[L, num_pages, KV, page_size, hd]`) plus one int32 block table `[rows,
+blocks_per_row]` shared by every layer: logical cache slot `t` of row `r`
+lives at page `table[r, t // page_size]`, offset `t % page_size`.  Rows that
+finish early hand their pages back to a free list so the continuous-batching
+scheduler (`sampler/paged/scheduler.py`) can prefill the next queued prompt
+into the freed pool mid-loop instead of draining the batch to its slowest row.
+
+Everything here is pure, static-shape, and jittable:
+
+  * `PageState` is a pytree of three arrays — a free-list stack `free` (the
+    first `top` entries are free page ids), the scalar stack pointer `top`,
+    and the block `table` itself.
+  * `alloc_row` / `release_row` are functional updates returning a new
+    `PageState`; `n_blocks` may be a traced value, so the scheduler can run
+    them inside jit without retracing per allocation size.
+  * Unallocated / released table entries hold the sentinel `num_pages`:
+    writes through the table use `mode="drop"` scatters, reads clamp to
+    `num_pages - 1`, so a sentinel entry can never corrupt a live page.
+
+Allocation policy is full-budget-at-admission: a row claims
+`blocks_per_row(prompt_len + max_tokens, page_size)` pages up front and
+releases them all on EOS.  That keeps the allocator out of the jitted decode
+carry entirely (no per-step allocation) at the cost of not reclaiming the
+unreached tail of short rows until they finish — see docs/PAGED_CACHE.md for
+the trade.
+"""
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+
+class PageState(NamedTuple):
+    """Free-list + block-table state.  `free[:top]` are free page ids (a
+    stack: allocation pops from index `top - 1` downward); entries at or
+    beyond `top` are dead storage.  `table[r, j]` is the physical page id of
+    row `r`'s j-th logical block, or the sentinel `num_pages` when
+    unallocated."""
+    free: jnp.ndarray   # [num_pages] int32
+    top: jnp.ndarray    # scalar int32 — number of free pages
+    table: jnp.ndarray  # [rows, blocks_per_row] int32
+
+
+def blocks_per_row(tokens: int, page_size: int) -> int:
+    """Pages a row needs to hold `tokens` logical cache slots."""
+    return -(-int(tokens) // int(page_size))
+
+
+def full_table(rows: int, n_blocks: int) -> jnp.ndarray:
+    """Dense identity table: row `r` owns pages `[r*n_blocks, (r+1)*n_blocks)`.
+
+    Used by the monolithic (non-queued) paged path, where the pool is exactly
+    `rows * n_blocks` pages and never recycles — this makes the paged cache a
+    pure re-layout of the contiguous one, which is what the bit-parity test
+    pins down."""
+    return jnp.arange(rows * n_blocks, dtype=jnp.int32).reshape(rows, n_blocks)
+
+
+def init_page_state(num_pages: int, rows: int, n_blocks: int) -> PageState:
+    """All pages free, all table entries sentinel."""
+    return PageState(
+        free=jnp.arange(num_pages, dtype=jnp.int32),
+        top=jnp.asarray(num_pages, jnp.int32),
+        table=jnp.full((rows, n_blocks), num_pages, jnp.int32),
+    )
+
+
+def alloc_row(state: PageState, row, n_blocks) -> Tuple[PageState, jnp.ndarray]:
+    """Pop `n_blocks` pages off the free stack into `table[row]`.
+
+    Returns `(new_state, ok)`; on `ok == False` (free list too short) the
+    state is returned unchanged — admission control in the scheduler gates on
+    this flag.  `row` and `n_blocks` may be traced."""
+    nb = state.table.shape[1]
+    num_pages = state.free.shape[0]
+    k = jnp.minimum(jnp.asarray(n_blocks, jnp.int32), nb)
+    ok = k <= state.top
+    idx = state.top - 1 - jnp.arange(nb, dtype=jnp.int32)
+    take = jnp.arange(nb, dtype=jnp.int32) < k
+    pages = jnp.where(take, state.free[jnp.clip(idx, 0, num_pages - 1)],
+                      num_pages)
+    new_row = jnp.where(ok, pages, state.table[row])
+    return PageState(
+        free=state.free,
+        top=jnp.where(ok, state.top - k, state.top),
+        table=state.table.at[row].set(new_row),
+    ), ok
+
+
+def release_row(state: PageState, row) -> Tuple[PageState, jnp.ndarray]:
+    """Push `table[row]`'s live pages back onto the free stack and reset the
+    row to sentinel.  Returns `(new_state, n_released)`.  Releasing an
+    already-sentinel row is a no-op (returns 0), so the scheduler may release
+    idempotently at every sync."""
+    nb = state.table.shape[1]
+    num_pages = state.free.shape[0]
+    pages = state.table[row]
+    valid = pages < num_pages
+    m = jnp.sum(valid.astype(jnp.int32))
+    rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    dest = jnp.where(valid, state.top + rank, num_pages)  # num_pages → drop
+    return PageState(
+        free=state.free.at[dest].set(pages, mode="drop"),
+        top=state.top + m,
+        table=state.table.at[row].set(
+            jnp.full((nb,), num_pages, jnp.int32)),
+    ), m

@@ -1,0 +1,386 @@
+"""Continuous batching over the paged KV cache: queued prompts, resident rows.
+
+The monolithic rollout loop sizes its batch to the WHOLE prompt set and runs
+until the slowest row finishes — a long-tail length distribution leaves most
+rows idle (emitting pads) for most of the loop. Compaction
+(sampler/compaction.py) approximated the fix by shrinking the batch between
+segments; this module does the real thing, the way continuous-batching
+servers (vLLM-style) do, but host-driven and offline-batch shaped:
+
+  * `decode_rows` rows are RESIDENT in a fixed-shape jitted decode loop over
+    a page pool sized for exactly those rows
+    (`decode_rows * ceil((Tp + max_tokens)/page_size)` pages).
+  * The loop runs in chunks of `sync_every` iterations. At each host sync,
+    rows that emitted EOS are flushed to the output buffer, their pages
+    handed back to the free list (`pages.release_row`), and the next queued
+    prompt is admitted mid-loop: `pages.alloc_row` claims the freed pages, a
+    single-row prefill writes the prompt KV through the row's new block
+    table into the shared pool, and the row's carry slots are re-installed.
+    Batch shape, pool shape, and compiled code never change.
+  * Decode iterations are counted (the carry's global counter only advances
+    while at least one row is live), which is what the long-tail test and
+    bench's `detail.paged` compare against the fixed-batch schedule.
+
+Speculative decode composes: `spec_k > 0` runs the SAME chunk structure over
+the speculative carry, reusing `speculative._draft_fn`/`_verify_fn` with the
+live block table — per-row accept lengths are already per-row bookkeeping,
+so admission just resets one row's slots.
+
+Determinism: row streams are NOT bit-identical to the monolithic loop. The
+per-iteration sampling key is `fold_in(key, it)` over the GLOBAL iteration
+counter (rows admitted later see different folds than a monolithic run
+would), and admitted rows draw their first token from
+`fold_in(key, _ADMIT_BASE + queue_index)`. Greedy streams differ only
+through chunk boundaries being invisible (they are: the carry is exact), so
+greedy queued output EQUALS greedy monolithic output row-for-row — pinned by
+tests/test_paged_cache.py — while sampled streams are merely equal in
+distribution.
+
+Safety of the recycled pool: a released row's table resets to the sentinel,
+so a still-resident-but-done row's writes DROP at the table-routed scatter
+(`core/model._paged_pages`) and its reads clamp to an arbitrary live page —
+finite garbage feeding a discarded logit. An admitted row's prefill
+overwrites every logical slot it will ever read, so stale page contents from
+the previous owner never leak through the masked attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.core.model import decode_step, prefill
+from nanorlhf_tpu.sampler.paged.pages import (
+    PageState, alloc_row, blocks_per_row, full_table, release_row,
+)
+from nanorlhf_tpu.sampler.sampler import (
+    _prefill_state,
+    _sample_token,
+    _token_logprob,
+)
+
+# admitted rows re-key the PRNG far away from the per-iteration fold_in
+# stream (iteration counters are bounded by max_tokens << this)
+_ADMIT_BASE = 10_000_000
+
+# the scheduler drives _prefill_state from the host (sampler.py's callers
+# run it inside their own jits), so it needs its own jit wrapper or the
+# initial batch prefill executes op-by-op
+_prefill_state_jit = partial(
+    jax.jit,
+    static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
+                     "temperature", "top_p", "greedy", "lora_scale", "top_k",
+                     "capture_logprobs", "approx_top_k", "page_size"),
+)(_prefill_state)
+
+_CHUNK_STATIC = (
+    "config", "Tp", "max_tokens", "page_size", "sync_every", "eos_token_id",
+    "pad_token_id", "temperature", "top_p", "greedy", "lora_scale", "top_k",
+    "capture_logprobs", "approx_top_k",
+)
+
+
+def _queued_decode_body(params, config, s, table, *, Tp, max_tokens,
+                        page_size, eos_token_id, pad_token_id, temperature,
+                        top_p, greedy, lora_scale, top_k, capture_logprobs,
+                        approx_top_k):
+    """One decode step over the queued carry — `sampler._decode_body`
+    generalized to PER-ROW generation counts (resident rows sit at
+    different depths) and table-routed cache writes."""
+    (it, out, lp_out, caches, key_mask, done, cur_tok, n_gen, prompt_len,
+     key) = s
+    R = cur_tok.shape[0]
+    rows = jnp.arange(R)
+    slot = Tp + n_gen - 1                      # [R] cache slot of cur_tok
+    key_mask = key_mask.at[rows, slot].set(True)
+    position = prompt_len + n_gen - 1
+    logits, caches = decode_step(
+        params, config, cur_tok, position, slot, key_mask, caches,
+        lora_scale=lora_scale, page_table=table, page_size=page_size,
+    )
+    tok = _sample_token(jax.random.fold_in(key, it), logits, temperature,
+                        top_p, greedy, top_k, approx_top_k)
+    tok = jnp.where(done, pad_token_id, tok)
+    live = ~done
+    wpos = jnp.where(live, n_gen, max_tokens)  # done rows drop their write
+    out = out.at[rows, wpos].set(tok, mode="drop")
+    if capture_logprobs:
+        lp = _token_logprob(logits, tok, temperature)
+        lp_out = lp_out.at[rows, wpos].set(lp, mode="drop")
+    cur_tok = jnp.where(live, tok, cur_tok)
+    n_gen = n_gen + live.astype(jnp.int32)
+    done = done | (tok == eos_token_id) | (n_gen >= max_tokens)
+    return (it + 1, out, lp_out, caches, key_mask, done, cur_tok, n_gen,
+            prompt_len, key)
+
+
+@partial(jax.jit, static_argnames=_CHUNK_STATIC)
+def _decode_chunk(params, config, state, table, **statics):
+    """Up to `sync_every` decode iterations; exits early once every resident
+    row is done (the iteration counter then stops, so it counts true decode
+    dispatches)."""
+    sync_every = statics.pop("sync_every")
+
+    def cond(cs):
+        c, s = cs
+        return (c < sync_every) & ~jnp.all(s[5])
+
+    def body(cs):
+        c, s = cs
+        return c + 1, _queued_decode_body(params, config, s, table, **statics)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+_SPEC_CHUNK_STATIC = _CHUNK_STATIC + ("spec_k", "spec_ngram")
+
+
+@partial(jax.jit, static_argnames=_SPEC_CHUNK_STATIC)
+def _spec_chunk(params, config, state, table, prompt_rep, **statics):
+    """Speculative twin of `_decode_chunk`: draft + verify per iteration
+    over the 15-slot speculative carry, with the live block table routed
+    into the verify forward. `prompt_rep` is the RESIDENT prompts [R, Tp]
+    (it changes at admission, hence a traced argument)."""
+    from nanorlhf_tpu.sampler.speculative import _draft_fn, _verify_fn
+
+    sync_every = statics.pop("sync_every")
+    spec_ngram = statics.pop("spec_ngram")
+    ver_kw = dict(statics)
+    ver_kw.pop("pad_token_id")
+    spec_k = statics["spec_k"]
+    Tp, pad = statics["Tp"], statics["pad_token_id"]
+
+    def cond(cs):
+        c, s = cs
+        return (c < sync_every) & ~jnp.all(s[5])
+
+    def body(cs):
+        c, s = cs
+        drafts = _draft_fn(prompt_rep, s, Tp=Tp, spec_k=spec_k,
+                           spec_ngram=spec_ngram, pad_token_id=pad)
+        return c + 1, _verify_fn(params, config, s, drafts, page_table=table,
+                                 pad_token_id=pad, **ver_kw)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+@partial(jax.jit, static_argnames=("config", "page_size", "T_max",
+                                   "temperature", "top_p", "greedy", "top_k",
+                                   "approx_top_k", "lora_scale"))
+def _admit_one(params, config, pids, pmask, caches, row_table, key, *,
+               page_size, T_max, temperature, top_p, greedy, top_k,
+               approx_top_k, lora_scale):
+    """Single-row admission prefill: write the prompt KV through the row's
+    freshly allocated block table into the SHARED pool, sample the first
+    token. pids/pmask: [1, Tp]; row_table: [nb]. Returns
+    (caches, tok0, lp0, prompt_len) with row-0 scalars."""
+    logits, caches = prefill(
+        params, config, pids, pmask.astype(bool), caches,
+        lora_scale=lora_scale, page_table=row_table[None, :],
+        page_size=page_size, logical_len=T_max,
+    )
+    tok0 = _sample_token(key, logits, temperature, top_p, greedy, top_k,
+                         approx_top_k)
+    lp0 = _token_logprob(logits, tok0, temperature)
+    plen = jnp.sum(pmask.astype(jnp.int32), axis=1)
+    return caches, tok0[0], lp0[0], plen[0]
+
+
+@partial(jax.jit, static_argnames=("Tp", "max_tokens", "eos_token_id",
+                                   "pad_token_id", "spec"))
+def _install_row(state, caches, r, tok0, lp0, pmask_row, plen, *, Tp,
+                 max_tokens, eos_token_id, pad_token_id, spec):
+    """Re-initialize resident row `r` of the carry for a freshly admitted
+    prompt (out/lp rows cleared, key_mask reset to the prompt mask, counters
+    to the post-prefill values). Works for both carry layouts — the first
+    ten slots of the spec carry line up, and `spec` additionally resets the
+    per-row accepted-draft counter."""
+    s = list(state)
+    T_mask = s[4].shape[1]
+    s[3] = caches
+    s[1] = s[1].at[r].set(
+        jnp.full((max_tokens,), pad_token_id, jnp.int32).at[0].set(tok0))
+    s[2] = s[2].at[r].set(jnp.zeros((max_tokens,), jnp.float32).at[0].set(lp0))
+    s[4] = s[4].at[r].set(
+        jnp.zeros((T_mask,), bool).at[:Tp].set(pmask_row.astype(bool)))
+    s[5] = s[5].at[r].set(tok0 == eos_token_id)
+    s[6] = s[6].at[r].set(tok0)
+    s[7] = s[7].at[r].set(jnp.int32(1))
+    s[8] = s[8].at[r].set(plen)
+    if spec:
+        s[14] = s[14].at[r].set(jnp.int32(0))
+    return tuple(s)
+
+
+_release_jit = jax.jit(release_row)
+_alloc_jit = jax.jit(alloc_row)
+
+
+def generate_tokens_queued(
+    params: dict,
+    config,
+    prompt_ids: jnp.ndarray,    # [Q, Tp] — ALL queued prompts, left-padded
+    prompt_mask: jnp.ndarray,   # [Q, Tp]
+    key: jax.Array,
+    *,
+    max_tokens: int,
+    eos_token_id: int,
+    pad_token_id: int,
+    page_size: int,
+    decode_rows: int,
+    spec_k: int = 0,
+    spec_ngram: int = 3,
+    temperature: float = 1.0,
+    top_p: float = 0.95,
+    greedy: bool = False,
+    lora_scale: float = 1.0,
+    top_k: int = 64,
+    capture_logprobs: bool = False,
+    approx_top_k: bool = True,
+    sync_every: int = 8,
+    spec_stats_out: list | None = None,
+    paged_stats_out: list | None = None,
+):
+    """Host-driven continuous-batching generation: `generate_tokens`
+    contract over the whole queue ([Q, max_tokens] int32 in queue order, or
+    (tokens, logprobs) with capture), with only `decode_rows` rows resident
+    at a time and finished rows' pages recycled to the next queued prompt
+    mid-loop. See the module docstring for scheduling/determinism notes."""
+    Q, Tp = prompt_ids.shape
+    R = min(int(decode_rows), Q)
+    P = int(page_size)
+    T_max = Tp + max_tokens
+    nb = blocks_per_row(T_max, P)
+    N = R * nb
+    spec = spec_k > 0
+
+    # ---- initial admission: batch-prefill the first R prompts. The fresh
+    # pool is fully claimed by the identity table (exactly what
+    # _prefill_state builds), so the allocator starts with an EMPTY free
+    # list; release/alloc churn begins at the first EOS.
+    base = _prefill_state_jit(
+        params, config, prompt_ids[:R], prompt_mask[:R], key,
+        max_tokens=max_tokens, eos_token_id=eos_token_id,
+        pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
+        greedy=greedy, lora_scale=lora_scale, top_k=top_k,
+        capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+        page_size=P,
+    )
+    (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
+    pstate = PageState(free=jnp.arange(N, dtype=jnp.int32),
+                       top=jnp.asarray(0, jnp.int32),
+                       table=full_table(R, nb))
+    n_gen0 = jnp.ones((R,), jnp.int32)
+    if spec:
+        from nanorlhf_tpu.sampler.speculative import _spec_state
+        state = _spec_state(base)
+    else:
+        state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0, tok0,
+                 n_gen0, plen0, key)
+
+    statics = dict(
+        Tp=Tp, max_tokens=max_tokens, page_size=P, sync_every=int(sync_every),
+        eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+        temperature=temperature, top_p=top_p, greedy=greedy,
+        lora_scale=lora_scale, top_k=top_k,
+        capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+    )
+    if spec:
+        statics.update(spec_k=spec_k, spec_ngram=spec_ngram)
+
+    # host bookkeeping
+    out_all = np.full((Q, max_tokens), pad_token_id, np.int32)
+    lp_all = np.zeros((Q, max_tokens), np.float32)
+    acc_all = np.zeros((Q,), np.int64)            # spec: accepted drafts/row
+    owner = list(range(R))                        # resident row → queue index
+    prompt_np = np.asarray(prompt_ids)
+    pmask_np = np.asarray(prompt_mask)
+    prompt_res_np = np.array(prompt_np[:R])       # resident prompts (spec)
+    prompt_rep = jnp.asarray(prompt_res_np)
+    next_q = R
+    recycled = 0
+    admissions: list[dict] = []
+    util_samples: list[float] = []
+
+    while True:
+        if spec:
+            state = _spec_chunk(params, config, state, pstate.table,
+                                prompt_rep, **statics)
+        else:
+            state = _decode_chunk(params, config, state, pstate.table,
+                                  **statics)
+        done_h = np.asarray(state[5])
+        it_now = int(state[0]) - 1
+        if spec:
+            row_acc_h = np.asarray(state[14])
+
+        finished = [r for r in range(R) if done_h[r] and owner[r] >= 0]
+        for r in finished:
+            q = owner[r]
+            out_all[q] = np.asarray(state[1][r])
+            if capture_logprobs:
+                lp_all[q] = np.asarray(state[2][r])
+            if spec:
+                acc_all[q] = int(row_acc_h[r])
+            owner[r] = -1
+            pstate, m = _release_jit(pstate, r)
+            recycled += int(m)
+        for r in finished:
+            if next_q >= Q:
+                continue
+            q = next_q
+            next_q += 1
+            pstate, ok = _alloc_jit(pstate, r, nb)
+            assert bool(ok), "allocator underflow: full-budget rows recycle uniformly"
+            caches, t0, l0, pl = _admit_one(
+                params, config, prompt_ids[q:q + 1], prompt_mask[q:q + 1],
+                state[3], pstate.table[r],
+                jax.random.fold_in(key, _ADMIT_BASE + q),
+                page_size=P, T_max=T_max, temperature=temperature,
+                top_p=top_p, greedy=greedy, top_k=top_k,
+                approx_top_k=approx_top_k, lora_scale=lora_scale,
+            )
+            state = _install_row(
+                state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
+                max_tokens=max_tokens, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id, spec=spec,
+            )
+            owner[r] = q
+            if spec:
+                prompt_res_np[r] = prompt_np[q]
+                prompt_rep = jnp.asarray(prompt_res_np)
+            admissions.append({"row": r, "queue_index": q,
+                               "iteration": it_now})
+        # pool occupancy AFTER this sync's churn: allocated / total pages
+        util_samples.append(1.0 - float(np.asarray(pstate.top)) / N)
+        if next_q >= Q and all(o < 0 for o in owner):
+            break
+
+    n_iter = int(state[0]) - 1
+    if paged_stats_out is not None:
+        paged_stats_out.append({
+            "page_utilization": float(np.mean(util_samples)),
+            "pages_recycled": recycled,
+            "admitted_midloop": len(admissions),
+            "decode_iterations": n_iter,
+            "rows": R,
+            "num_pages": N,
+            "page_size": P,
+            "admissions": admissions,
+        })
+    if spec and spec_stats_out is not None:
+        spec_stats_out.append({
+            "verify_steps": n_iter,
+            "drafted": state[10], "accepted": state[11],
+            "emitted": state[12], "row_steps": state[13],
+            "accepted_rows": jnp.asarray(acc_all.astype(np.int32)),
+        })
+    toks = jnp.asarray(out_all)
+    if capture_logprobs:
+        return toks, jnp.asarray(lp_all)
+    return toks

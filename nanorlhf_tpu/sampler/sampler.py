@@ -29,8 +29,11 @@ import jax
 import jax.numpy as jnp
 
 from nanorlhf_tpu.core.config import ModelConfig
-from nanorlhf_tpu.core.model import decode_step, init_kv_cache, prefill
+from nanorlhf_tpu.core.model import (
+    decode_step, init_kv_cache, init_paged_kv_cache, prefill,
+)
 from nanorlhf_tpu.ops.masking import guard_temperature
+from nanorlhf_tpu.sampler.paged.pages import full_table
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,16 +74,42 @@ class SamplingParams:
     # in the reference's vLLM path too). Set False for the exact candidate
     # set (full-sort cost on TPU).
     approx_top_k: bool = True
+    # LEGACY (contiguous-layout-only) straggler lever — prefer `page_size`.
     # >0 enables compacting decode (sampler/compaction.py): the loop runs in
     # this many segments, and between segments finished rows are flushed and
-    # live rows gathered into a smaller power-of-two batch — the
-    # static-shape analogue of vLLM's continuous batching. 0 = monolithic
+    # live rows gathered into a smaller power-of-two batch — a batch-shrink
+    # approximation of continuous batching that the paged KV cache
+    # supersedes: `page_size` > 0 with `decode_rows` > 0 recycles finished
+    # rows' cache pages to QUEUED prompts mid-loop (true continuous
+    # batching) and, unlike compaction, composes with spec_k. 0 = monolithic
     # single-jit loop (bit-stable row streams, fully async dispatch).
-    # Mutually exclusive with spec_k > 0: compaction's row gather assumes
-    # every live row sits at the same decode step (shared cache-slot
-    # layout), which speculative decode's per-row accept lengths break —
-    # `generate` raises on the combination.
+    # Mutually exclusive with spec_k > 0 AND with page_size > 0: compaction's
+    # row gather assumes every live row sits at the same decode step (shared
+    # cache-slot layout), which per-row accept lengths / per-row fill breaks
+    # — `generate` raises on either combination.
     compaction_segments: int = 0
+    # >0 switches the KV cache to the PAGED layout (sampler/paged/,
+    # docs/PAGED_CACHE.md): K/V live in a global pool of page_size-token
+    # pages addressed through a per-row block table instead of a per-row
+    # [T_max] slab. On its own (decode_rows == 0) this is a pure re-layout —
+    # greedy token streams are bit-identical to the contiguous cache on the
+    # CPU mesh (test-pinned) — and it composes with spec_k (paged verify
+    # writes) and kv_cache_quant="int8" (paged scale pools). Pick
+    # page_size >= 128 on real TPUs (lane-tile alignment for the paged
+    # kernels' int8 scale blocks); CPU tests run any size via interpret
+    # mode. 0 = contiguous slabs, bit-for-bit untouched.
+    page_size: int = 0
+    # page_size > 0 only: >0 enables CONTINUOUS BATCHING — the decode loop
+    # runs `decode_rows` resident rows over a page pool sized for exactly
+    # that many rows, and when a row EOSes mid-loop its pages are released
+    # and the next queued prompt is prefilled into the freed pool
+    # (sampler/paged/scheduler.py). The long-tail win compaction
+    # approximated, without its same-step restriction: works with spec_k.
+    # Host-driven (one sync per chunk of decode iterations); row streams
+    # are NOT bit-identical to the monolithic loop (admission re-keys the
+    # PRNG per row). n > 1 fanout falls back to repeated-prompt prefill on
+    # this path. 0 (or >= the total row count) = monolithic paged loop.
+    decode_rows: int = 0
     # >0 enables draft-free speculative decode (sampler/speculative.py): a
     # jitted n-gram/prompt-lookup drafter proposes spec_k tokens per row
     # from the row's own prompt+output buffer, and ONE `decode_verify`
@@ -94,7 +123,9 @@ class SamplingParams:
     # variates instead of one categorical per step). capture_logprobs
     # reuses the verify logits, so accepted tokens still carry
     # full-distribution logprobs. 0 = this loop, bit-for-bit untouched.
-    # Incompatible with compaction_segments > 0 (see above).
+    # Incompatible with compaction_segments > 0 (see above); composes with
+    # page_size > 0 (paged verify writes) including the continuous-batching
+    # decode_rows path — the modern replacement for that exclusion.
     spec_k: int = 0
     # n-gram context length the drafter matches on (spec_k > 0 only):
     # smaller = more matches (higher draft rate, lower precision), larger =
@@ -271,7 +302,8 @@ def _token_logprob(logits, tok, temperature):
     jax.jit,
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
                      "temperature", "top_p", "greedy", "lora_scale", "top_k",
-                     "capture_logprobs", "approx_top_k", "prompt_fanout"),
+                     "capture_logprobs", "approx_top_k", "prompt_fanout",
+                     "page_size"),
 )
 def generate_tokens(
     params: dict,
@@ -291,11 +323,14 @@ def generate_tokens(
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
     prompt_fanout: int = 1,
+    page_size: int = 0,
 ) -> jnp.ndarray:
     """Core jitted loop: one sample per row. Returns [B*fanout, max_tokens]
     int32, or (tokens, logprobs f32) with capture_logprobs. `prompt_fanout`
     N prefills the [B] prompts once and decodes N samples per prompt
-    (prompt-major rows), sharing the prompt KV."""
+    (prompt-major rows), sharing the prompt KV. `page_size` > 0 runs the
+    same loop over the paged KV layout (dense identity block table — no
+    recycling here; see sampler/paged/scheduler.py for that)."""
     Tp = prompt_ids.shape[1]
     state = _prefill_state(
         params, config, prompt_ids, prompt_mask, key,
@@ -303,7 +338,7 @@ def generate_tokens(
         pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
         greedy=greedy, lora_scale=lora_scale, top_k=top_k,
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
-        prompt_fanout=prompt_fanout,
+        prompt_fanout=prompt_fanout, page_size=page_size,
     )
 
     def cond(state):
@@ -316,6 +351,7 @@ def generate_tokens(
             temperature=temperature, top_p=top_p, greedy=greedy,
             lora_scale=lora_scale, top_k=top_k,
             capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+            page_size=page_size,
         )
 
     _, out, lp_out, _, _, _, _, _, _ = jax.lax.while_loop(cond, body, state)
@@ -325,7 +361,8 @@ def generate_tokens(
 def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
                    max_tokens, eos_token_id, pad_token_id, temperature,
                    top_p, greedy, lora_scale, top_k, capture_logprobs,
-                   approx_top_k, prompt_fanout=1, cache_extra=0):
+                   approx_top_k, prompt_fanout=1, cache_extra=0,
+                   page_size=0):
     """Prefill + first sampled token → the decode-loop carry state:
     (step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key).
     Per-step sampling keys are fold_in(key, step), so a segment boundary
@@ -343,22 +380,60 @@ def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
     `cache_extra` pads the KV cache/key_mask past Tp + max_tokens — the
     speculative path (spec_k slack) needs room for a full k+1 candidate
     write when a row sits one token short of the budget; 0 (every other
-    caller) keeps shapes bit-identical to before."""
+    caller) keeps shapes bit-identical to before. GATED TO THE CONTIGUOUS
+    LAYOUT: on the paged path (`page_size` > 0) the slack is forced to 0 —
+    a row's page budget ceil(T_max/page_size) already rounds up past the
+    logical width, and a verify write past the budget drops at the
+    table-routed scatter instead of clobbering a neighbor row, so reserved
+    slots buy nothing (the dropped candidates are beyond `max_tokens` and
+    are truncated before emission either way — docs/PAGED_CACHE.md walks
+    the bound).
+
+    `page_size` > 0 allocates the paged layout instead of contiguous slabs:
+    a pool of exactly B*ceil(T_max/page_size) pages with the dense identity
+    table (`full_table`) — a pure re-layout of the contiguous cache, no
+    recycling, so this state is interchangeable with the contiguous one
+    token-for-token."""
     B, Tp = prompt_ids.shape
+    if page_size > 0:
+        cache_extra = 0
     T_max = Tp + max_tokens + cache_extra
     prompt_mask = prompt_mask.astype(bool)
     dtype = params["embed_tokens"].dtype
 
-    caches = init_kv_cache(config, B, T_max, dtype)
-    first_logits, caches = prefill(params, config, prompt_ids, prompt_mask, caches,
-                                   lora_scale=lora_scale)
+    if page_size > 0:
+        nb = -(-T_max // page_size)
+        caches = init_paged_kv_cache(config, B * nb, page_size, dtype)
+        first_logits, caches = prefill(
+            params, config, prompt_ids, prompt_mask, caches,
+            lora_scale=lora_scale, page_table=full_table(B, nb),
+            page_size=page_size, logical_len=T_max,
+        )
+    else:
+        caches = init_kv_cache(config, B, T_max, dtype)
+        first_logits, caches = prefill(params, config, prompt_ids, prompt_mask,
+                                       caches, lora_scale=lora_scale)
 
     if prompt_fanout > 1:
         first_logits = jnp.repeat(first_logits, prompt_fanout, axis=0)
-        # caches are stacked [L, B, KV, T, d] — batch on axis 1
-        caches = jax.tree.map(
-            lambda c: jnp.repeat(c, prompt_fanout, axis=1), caches
-        )
+        if page_size > 0:
+            # pools are stacked [L, B*nb, ...]: fan out whole page GROUPS so
+            # row r of the fanned table (identity again) lands on a copy of
+            # proto row r // N's pages — the same values the contiguous
+            # repeat produces, page-major
+            nb = -(-T_max // page_size)
+            caches = jax.tree.map(
+                lambda c: jnp.repeat(
+                    c.reshape(c.shape[0], B, nb, *c.shape[2:]),
+                    prompt_fanout, axis=1,
+                ).reshape(c.shape[0], B * prompt_fanout * nb, *c.shape[2:]),
+                caches,
+            )
+        else:
+            # caches are stacked [L, B, KV, T, d] — batch on axis 1
+            caches = jax.tree.map(
+                lambda c: jnp.repeat(c, prompt_fanout, axis=1), caches
+            )
         prompt_mask = jnp.repeat(prompt_mask, prompt_fanout, axis=0)
         B = B * prompt_fanout
 
@@ -379,10 +454,18 @@ def _prefill_state(params, config, prompt_ids, prompt_mask, key, *,
 
 def _decode_body(params, config, state, *, Tp, max_tokens, eos_token_id,
                  pad_token_id, temperature, top_p, greedy, lora_scale, top_k,
-                 capture_logprobs, approx_top_k):
+                 capture_logprobs, approx_top_k, page_size=0):
     """One decode step over the carry state (shared by the monolithic
-    while_loop above and the segmented/compacting loop)."""
+    while_loop above and the segmented/compacting loop). `page_size` > 0:
+    the caches in the carry are paged pools; the dense identity table is a
+    shape-derived constant (pool pages // batch rows), so the carry layout
+    is unchanged."""
     step, out, lp_out, caches, key_mask, done, cur_tok, prompt_len, key = state
+    paged_kw = {}
+    if page_size > 0:
+        B = key_mask.shape[0]
+        paged_kw = dict(page_table=full_table(B, caches[0].shape[1] // B),
+                        page_size=page_size)
     # token t was sampled from logits at position prompt_len + step - 1;
     # its KV lands in cache slot Tp + step - 1
     cache_slot = Tp + step - 1
@@ -390,7 +473,7 @@ def _decode_body(params, config, state, *, Tp, max_tokens, eos_token_id,
     position = prompt_len + step - 1
     logits, caches = decode_step(
         params, config, cur_tok, position, cache_slot, key_mask, caches,
-        lora_scale=lora_scale,
+        lora_scale=lora_scale, **paged_kw,
     )
     tok = _sample_token(jax.random.fold_in(key, step), logits, temperature,
                         top_p, greedy, top_k, approx_top_k)
@@ -418,6 +501,7 @@ def generate(
     batch_sharding=None,
     spec_stats_out: list | None = None,
     tracer=None,
+    paged_stats_out: list | None = None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
     prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
@@ -433,15 +517,50 @@ def generate(
     telemetry.SpanTracer) switches the speculative path to its
     host-driven loop with real per-iteration "rollout.draft"/
     "rollout.verify" spans (one sync per verify step — observability
-    mode, not the fully-async default)."""
+    mode, not the fully-async default).
+
+    `paged_stats_out` (page_size > 0 only): same pattern for the paged
+    cache — a dict with page_utilization / pages_recycled /
+    admitted_midloop (+ per-admission records on the continuous-batching
+    path) feeding the trainer's rollout/page_* metrics, the /statusz
+    `pages` section, and lineage lease events."""
+    total_rows = prompt_ids.shape[0] * sampling.n
+    queued = (sampling.page_size > 0 and sampling.decode_rows > 0
+              and sampling.decode_rows < total_rows)
     fanout = 1
     if sampling.n > 1:
-        if sampling.shared_prompt_prefill:
+        if sampling.shared_prompt_prefill and not queued:
             # prompts stay [B]; prefill-once-fan-out happens inside the jit
             fanout = sampling.n
         else:
+            # queued admission prefills one row at a time — no shared-prefill
+            # fan-out there, each logical row becomes its own queue entry
             prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
             prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
+    if sampling.page_size > 0 and sampling.compaction_segments > 0:
+        raise ValueError(
+            "page_size > 0 is incompatible with compaction_segments > 0: "
+            "compaction is the legacy contiguous-layout straggler lever "
+            "(same-step row gathers over per-row slabs), and the paged "
+            "cache replaces it outright — set decode_rows > 0 for true "
+            "continuous batching over recycled pages instead of batch "
+            "shrinking (sampler/paged/scheduler.py)."
+        )
+    if queued:
+        from nanorlhf_tpu.sampler.paged.scheduler import generate_tokens_queued
+
+        return generate_tokens_queued(
+            params, config, prompt_ids, prompt_mask, key,
+            max_tokens=sampling.max_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, page_size=sampling.page_size,
+            decode_rows=sampling.decode_rows, spec_k=sampling.spec_k,
+            spec_ngram=sampling.spec_ngram,
+            temperature=sampling.temperature, top_p=sampling.top_p,
+            greedy=sampling.greedy, lora_scale=lora_scale,
+            top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
+            approx_top_k=sampling.approx_top_k,
+            spec_stats_out=spec_stats_out, paged_stats_out=paged_stats_out,
+        )
     if sampling.spec_k > 0:
         if sampling.compaction_segments > 0:
             raise ValueError(
@@ -449,13 +568,15 @@ def generate(
                 "compacting decode gathers rows under the assumption that "
                 "every live row sits at the same decode step (shared "
                 "cache-slot layout, sampler/compaction.py), which "
-                "speculative decode's per-row accept lengths break. Pick "
-                "one lever: spec_k for repetitive/self-similar rollouts, "
-                "compaction for straggler-dominated length distributions."
+                "speculative decode's per-row accept lengths break. "
+                "Compaction is legacy — the preferred straggler fix is the "
+                "paged cache (SamplingParams.page_size > 0 with "
+                "decode_rows > 0), whose continuous batching COMPOSES with "
+                "spec_k instead of excluding it."
             )
         from nanorlhf_tpu.sampler.speculative import generate_spec
 
-        return generate_spec(
+        result = generate_spec(
             params, config, prompt_ids, prompt_mask, key,
             max_tokens=sampling.max_tokens, eos_token_id=eos_token_id,
             pad_token_id=pad_token_id, spec_k=sampling.spec_k,
@@ -465,7 +586,11 @@ def generate(
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k, prompt_fanout=fanout,
             spec_stats_out=spec_stats_out, tracer=tracer,
+            page_size=sampling.page_size,
         )
+        _monolithic_paged_stats(result, sampling, prompt_mask, fanout,
+                                pad_token_id, paged_stats_out)
+        return result
     if sampling.compaction_segments > 0:
         from nanorlhf_tpu.sampler.compaction import generate_tokens_compact
 
@@ -480,7 +605,7 @@ def generate(
             batch_sharding=batch_sharding,
             prompt_fanout=fanout,
         )
-    return generate_tokens(
+    result = generate_tokens(
         params,
         config,
         prompt_ids,
@@ -497,4 +622,34 @@ def generate(
         capture_logprobs=sampling.capture_logprobs,
         approx_top_k=sampling.approx_top_k,
         prompt_fanout=fanout,
+        page_size=sampling.page_size,
     )
+    _monolithic_paged_stats(result, sampling, prompt_mask, fanout,
+                            pad_token_id, paged_stats_out)
+    return result
+
+
+def _monolithic_paged_stats(result, sampling, prompt_mask, fanout,
+                            pad_token_id, paged_stats_out):
+    """Fill `paged_stats_out` for the monolithic (non-queued) paged paths:
+    no recycling, no admissions — utilization is just final cache occupancy
+    over the fully-provisioned pool. Device scalars only (no sync; the
+    trainer materializes them at metrics time like spec_stats)."""
+    if paged_stats_out is None or sampling.page_size <= 0:
+        return
+    toks = result[0] if sampling.capture_logprobs else result
+    rows, Tp = toks.shape[0], prompt_mask.shape[1]
+    P = sampling.page_size
+    nb = -(-(Tp + sampling.max_tokens) // P)
+    used = (jnp.sum(prompt_mask) * fanout
+            + jnp.sum(toks != pad_token_id)).astype(jnp.float32)
+    paged_stats_out.append({
+        "page_utilization": used / jnp.float32(rows * nb * P),
+        "pages_recycled": jnp.int32(0),
+        "admitted_midloop": jnp.int32(0),
+        "decode_iterations": None,
+        "rows": rows,
+        "num_pages": rows * nb,
+        "page_size": P,
+        "admissions": [],
+    })

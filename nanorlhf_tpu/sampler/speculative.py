@@ -42,11 +42,19 @@ forward) is made visible by extending `key_mask`, and rejected candidates
 leave garbage KV in never-validated slots that the next verify overwrites.
 The KV cache carries `spec_k` slack slots past Tp + max_tokens so a row
 one token short of the budget can still absorb a full k+1 candidate write
-without clamping into valid slots.
+without clamping into valid slots. On the PAGED layout (`page_size` > 0)
+that slack is gated to 0: a candidate write past the row's page budget
+drops at the table-routed scatter instead of clobbering anything, and the
+dropped positions sit beyond `max_tokens - n_gen`, which the emission
+clamp truncates anyway — see docs/PAGED_CACHE.md for the bound.
 
 Interaction with compaction (sampler/compaction.py): mutually exclusive —
 compaction's row gather assumes all rows share the same step alignment,
 which per-row accept lengths break; `generate` raises on the combination.
+The paged cache (SamplingParams.page_size) is the replacement straggler
+lever and COMPOSES with this path: monolithic paged verify here, and the
+continuous-batching scheduler (sampler/paged/scheduler.py) reuses
+`_draft_fn`/`_verify_fn` directly with a live block table.
 
 `capture_logprobs` reuses the verify logits: accepted tokens carry the
 same full-distribution logprob `_token_logprob` computes in the monolithic
@@ -64,6 +72,7 @@ import numpy as np
 from nanorlhf_tpu.core.config import ModelConfig
 from nanorlhf_tpu.core.model import decode_verify
 from nanorlhf_tpu.ops.masking import guard_temperature
+from nanorlhf_tpu.sampler.paged.pages import full_table
 from nanorlhf_tpu.sampler.sampler import (
     _prefill_state,
     filtered_logits_full,
@@ -74,12 +83,12 @@ from nanorlhf_tpu.sampler.sampler import (
 _GEN_STATIC = (
     "config", "max_tokens", "eos_token_id", "pad_token_id", "spec_k",
     "spec_ngram", "temperature", "top_p", "greedy", "lora_scale", "top_k",
-    "capture_logprobs", "approx_top_k", "prompt_fanout",
+    "capture_logprobs", "approx_top_k", "prompt_fanout", "page_size",
 )
 _VERIFY_STATIC = (
     "config", "Tp", "max_tokens", "eos_token_id", "pad_token_id", "spec_k",
     "temperature", "top_p", "greedy", "lora_scale", "top_k",
-    "capture_logprobs", "approx_top_k",
+    "capture_logprobs", "approx_top_k", "page_size",
 )
 
 
@@ -185,23 +194,34 @@ def _draft_fn(prompt_rep, state, *, Tp, spec_k, spec_ngram, pad_token_id):
 
 def _verify_fn(params, config, state, drafts, *, Tp, max_tokens,
                eos_token_id, pad_token_id, spec_k, temperature, top_p,
-               greedy, lora_scale, top_k, capture_logprobs, approx_top_k):
+               greedy, lora_scale, top_k, capture_logprobs, approx_top_k,
+               page_size=0, page_table=None):
     """Verify + accept + per-row bookkeeping: one forward over the k+1
     candidates, the acceptance rule, then masked multi-token output
     writes, per-row cache-length/key_mask advance, EOS/budget termination,
-    and the acceptance counters."""
+    and the acceptance counters.
+
+    `page_size` > 0 runs the verify forward against the paged cache; a
+    `page_table` of None rebuilds the dense identity table from the pool
+    shape (the monolithic paged path), while the continuous-batching
+    scheduler passes its live recycled table."""
     (it, out, lp_out, caches, key_mask, done, cur_tok, n_gen, prompt_len,
      key, n_drafted, n_accepted, n_emitted, n_rowsteps, row_acc) = state
     B = cur_tok.shape[0]
     K1 = spec_k + 1
     arange = jnp.arange(K1)[None, :]
 
+    paged_kw = {}
+    if page_size > 0:
+        if page_table is None:
+            page_table = full_table(B, caches[0].shape[1] // B)
+        paged_kw = dict(page_table=page_table, page_size=page_size)
     tokens = jnp.concatenate([cur_tok[:, None], drafts], axis=1)
     positions = (prompt_len + n_gen - 1)[:, None] + jnp.arange(K1)[None, :]
     fill = Tp + n_gen - 1                                   # [B] slot of cur_tok
     logits, caches = decode_verify(
         params, config, tokens, positions, fill, key_mask, caches,
-        lora_scale=lora_scale,
+        lora_scale=lora_scale, **paged_kw,
     )
     emitted, acc = accept_candidates(
         logits, drafts, jax.random.fold_in(key, it),
@@ -299,6 +319,7 @@ def generate_tokens_spec(
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
     prompt_fanout: int = 1,
+    page_size: int = 0,
 ):
     """Jitted speculative decode loop (the async default). Same output
     contract as `generate_tokens` plus a stats tuple:
@@ -317,6 +338,7 @@ def generate_tokens_spec(
         greedy=greedy, lora_scale=lora_scale, top_k=top_k,
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
         prompt_fanout=prompt_fanout, cache_extra=spec_k,
+        page_size=page_size,
     )
     prompt_rep = (
         jnp.repeat(prompt_ids, prompt_fanout, axis=0)
@@ -328,6 +350,7 @@ def generate_tokens_spec(
         pad_token_id=pad_token_id, spec_k=spec_k, temperature=temperature,
         top_p=top_p, greedy=greedy, lora_scale=lora_scale, top_k=top_k,
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+        page_size=page_size,
     )
 
     def cond(s):
@@ -355,7 +378,7 @@ _prefill_jit = partial(
     static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
                      "temperature", "top_p", "greedy", "lora_scale", "top_k",
                      "capture_logprobs", "approx_top_k", "prompt_fanout",
-                     "cache_extra"),
+                     "cache_extra", "page_size"),
 )(_prefill_state)
 
 
@@ -372,6 +395,8 @@ def _generate_spec_instrumented(params, config, prompt_ids, prompt_mask, key,
     prompt_fanout = kw["prompt_fanout"]
     pre_kw = {k: v for k, v in kw.items()
               if k not in ("spec_k", "spec_ngram", "prompt_fanout")}
+    # page_size rides through pre_kw (prefill allocates the pool and gates
+    # the cache_extra slack) and ver_kw (table-routed verify writes)
     base = _prefill_jit(params, config, prompt_ids, prompt_mask, key,
                         prompt_fanout=prompt_fanout, cache_extra=spec_k,
                         **pre_kw)
@@ -422,6 +447,7 @@ def generate_spec(
     prompt_fanout: int = 1,
     spec_stats_out: list | None = None,
     tracer=None,
+    page_size: int = 0,
 ):
     """`generate`-contract entry for the speculative path: returns tokens
     (or (tokens, logprobs) with capture), appending the stats dict to
@@ -434,7 +460,7 @@ def generate_spec(
         temperature=temperature, top_p=top_p, greedy=greedy,
         lora_scale=lora_scale, top_k=top_k,
         capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
-        prompt_fanout=prompt_fanout,
+        prompt_fanout=prompt_fanout, page_size=page_size,
     )
     if tracer is not None and getattr(tracer, "enabled", False):
         out, lp, stats = _generate_spec_instrumented(

@@ -281,14 +281,30 @@ class RLConfig:
     # (scoring/training have no cache); same off-policy-tolerance story as
     # rollout_quant.
     kv_cache_quant: str = "none"  # none | int8
-    # >0: rollouts use compacting decode (sampler/compaction.py) with this
-    # many segments — finished rows are flushed at segment boundaries and
-    # live rows gathered into a smaller power-of-two batch, so stragglers
-    # stop paying full-batch decode steps (the static-shape analogue of
-    # vLLM's continuous batching). Costs one compile per distinct batch
-    # size (cached) and a host sync per segment; see the compaction module
-    # docstring for the rollout_ahead interaction.
+    # LEGACY (contiguous layout only) — prefer rollout_page_size. >0:
+    # rollouts use compacting decode (sampler/compaction.py) with this many
+    # segments — finished rows are flushed at segment boundaries and live
+    # rows gathered into a smaller power-of-two batch. A batch-shrink
+    # approximation of continuous batching that the paged KV cache
+    # supersedes; mutually exclusive with rollout_page_size > 0 and with
+    # rollout_spec_k > 0.
     rollout_compaction_segments: int = 0
+    # >0: the rollout KV cache switches to the PAGED layout (sampler/paged/,
+    # docs/PAGED_CACHE.md) — K/V in a global pool of this-many-token pages
+    # addressed through per-row block tables. On its own a pure re-layout
+    # (greedy streams bit-identical to contiguous, test-pinned); with
+    # rollout_decode_rows > 0 it unlocks true continuous batching. Composes
+    # with rollout_spec_k and kv_cache_quant="int8". Use >= 128 on real
+    # TPUs (lane-tile alignment for the paged kernels); 0 = contiguous.
+    rollout_page_size: int = 0
+    # rollout_page_size > 0 only. >0: continuous batching — only this many
+    # rows are RESIDENT in the decode loop; when a row emits EOS its pages
+    # are released and the next queued prompt is prefilled into the freed
+    # pool mid-loop (sampler/paged/scheduler.py). Fixes the long-tail
+    # straggler cost compaction approximated, works with spec_k, feeds the
+    # rollout/page_* metrics + /statusz "pages" + lineage lease events.
+    # 0 (or >= the rollout batch) = monolithic paged loop.
+    rollout_decode_rows: int = 0
 
     # ---- resilience (resilience/, docs/RESILIENCE.md) ----
     # fault-injection spec ("point:at=N,..."); None falls back to the

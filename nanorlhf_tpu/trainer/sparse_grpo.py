@@ -337,6 +337,8 @@ class SparseGRPOTrainer(RLTrainer):
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
             shared_prompt_prefill=cfg.rollout_shared_prefill,
             spec_k=cfg.rollout_spec_k, spec_ngram=cfg.rollout_spec_ngram,
+            page_size=cfg.rollout_page_size,
+            decode_rows=cfg.rollout_decode_rows,
         )
         n_updates = (
             max(0, cfg.num_total_batches - self.state["global_step"])
@@ -353,14 +355,17 @@ class SparseGRPOTrainer(RLTrainer):
                 # mesh; _rollout_params() re-shards the param view there
                 q_j = jax.device_put(q_j, batch_sharding(self.rollout_mesh))
             spec_stats: list = []
+            paged_stats: list = []
             gen_out = generate(
                 self._rollout_params(), self._rollout_mcfg, q_j, q_j != pad_id, gk,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
                 spec_stats_out=spec_stats, tracer=self.tracer,
+                paged_stats_out=paged_stats,
             )
             return {"queries": queries, "gen_out": gen_out,
-                    "spec_stats": spec_stats[0] if spec_stats else None}
+                    "spec_stats": spec_stats[0] if spec_stats else None,
+                    "paged_stats": paged_stats[0] if paged_stats else None}
 
         stream = RolloutStream(self, rollout_body, meter=self._rollout_meter)
         # lineage (telemetry/lineage.py): whole-rollout drops are counted
@@ -391,6 +396,27 @@ class SparseGRPOTrainer(RLTrainer):
                     policy_version=self.state["global_step"], worker_id=0,
                     spec=spec_summary(ro),
                 )
+            pstats = ro.get("paged_stats")
+            if pstats is not None:
+                # /statusz "pages" snapshot + one lineage "lease" event per
+                # mid-loop admission — same contract as the dense loop
+                self._pages_status = {
+                    k: (None if pstats[k] is None
+                        else float(np.asarray(pstats[k])))
+                    for k in ("page_utilization", "pages_recycled",
+                              "admitted_midloop", "decode_iterations")
+                }
+                self._pages_status.update(
+                    rows=pstats["rows"], num_pages=pstats["num_pages"],
+                    page_size=pstats["page_size"],
+                )
+                if self.lineage.enabled:
+                    for adm in pstats.get("admissions") or []:
+                        self.lineage.event(
+                            "lease", rollout_index, midloop=True,
+                            row=adm["row"], queue_index=adm["queue_index"],
+                            iteration=adm["iteration"],
+                        )
             if capture:
                 responses, captured_lp = ro["gen_out"]
                 responses = np.asarray(responses)
@@ -691,6 +717,7 @@ class SparseGRPOTrainer(RLTrainer):
             # speculative-decode acceptance rows: the dense loop's one
             # definition (RLTrainer._spec_decode_metrics, docs/METRICS.md)
             metrics.update(self._spec_decode_metrics(ro.get("spec_stats")))
+            metrics.update(self._paged_metrics(ro.get("paged_stats")))
             # perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md): the
             # dense loop's napkin model with sparse-runtime token counts —
             # scoring/update tokens count only the KEPT (post-filter) rows

@@ -874,6 +874,25 @@ class RLTrainer:
             "rollout/spec_verify_steps": v_steps,
         }
 
+    @staticmethod
+    def _paged_metrics(paged_stats) -> dict:
+        """rollout/page_utilization + pages_recycled + admitted_midloop rows
+        (docs/METRICS.md) from a paged-cache stats dict — shared by the
+        dense and sparse loops like `_spec_decode_metrics`. The monolithic
+        paged path reports utilization with zero recycling/admissions; the
+        continuous-batching scheduler reports all three. {} when
+        rollout_page_size is off."""
+        if paged_stats is None:
+            return {}
+        return {
+            "rollout/page_utilization": float(
+                np.asarray(paged_stats["page_utilization"])),
+            "rollout/pages_recycled": float(
+                np.asarray(paged_stats["pages_recycled"])),
+            "rollout/admitted_midloop": float(
+                np.asarray(paged_stats["admitted_midloop"])),
+        }
+
     # ------------------------------------------------------------------ #
     # telemetry: perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md)
     # ------------------------------------------------------------------ #
@@ -964,6 +983,10 @@ class RLTrainer:
             # drop-reason counts since start + the last-N sample ring
             # (telemetry/lineage.py) — the live companion to the ledger
             "lineage": self.lineage.statusz(),
+            # paged KV cache (rollout_page_size > 0): latest rollout's pool
+            # occupancy / recycling / mid-loop admission snapshot; None when
+            # the lever is off
+            "pages": getattr(self, "_pages_status", None),
         }
         if orch is not None and hasattr(orch, "status_snapshot"):
             out.update(orch.status_snapshot())
@@ -1462,6 +1485,8 @@ class RLTrainer:
             top_k=cfg.rollout_top_k, approx_top_k=cfg.rollout_approx_top_k,
             shared_prompt_prefill=cfg.rollout_shared_prefill,
             spec_k=cfg.rollout_spec_k, spec_ngram=cfg.rollout_spec_ngram,
+            page_size=cfg.rollout_page_size,
+            decode_rows=cfg.rollout_decode_rows,
         )
 
         # after a resume, the default budget is the REMAINING updates, not a
@@ -1503,11 +1528,13 @@ class RLTrainer:
             # "rollout" track) when telemetry is on; a disabled tracer is
             # ignored.
             spec_stats: list = []
+            paged_stats: list = []
             gen_out = generate(
                 gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale, batch_sharding=bs,
                 spec_stats_out=spec_stats, tracer=self.tracer,
+                paged_stats_out=paged_stats,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:
@@ -1519,7 +1546,8 @@ class RLTrainer:
                     lora_scale=self.lora_scale,
                 )
             return {"queries": queries, "gen_out": gen_out, "greedy": greedy,
-                    "spec_stats": spec_stats[0] if spec_stats else None}
+                    "spec_stats": spec_stats[0] if spec_stats else None,
+                    "paged_stats": paged_stats[0] if paged_stats else None}
 
         from nanorlhf_tpu.orchestrator import ProducerFailed
         from nanorlhf_tpu.resilience import Preempted, ProducerWatchdog
@@ -1685,6 +1713,29 @@ class RLTrainer:
                     policy_version=self.state["global_step"], worker_id=0,
                     spec=spec_summary(ro),
                 )
+            pstats = ro.get("paged_stats")
+            if pstats is not None:
+                # /statusz "pages" panel reads the latest snapshot; lineage
+                # gets one "lease" event per mid-loop admission so a queued
+                # sample's provenance records WHICH recycled row produced it
+                # and at which decode iteration (runs in every rollout mode)
+                self._pages_status = {
+                    k: (None if pstats[k] is None
+                        else float(np.asarray(pstats[k])))
+                    for k in ("page_utilization", "pages_recycled",
+                              "admitted_midloop", "decode_iterations")
+                }
+                self._pages_status.update(
+                    rows=pstats["rows"], num_pages=pstats["num_pages"],
+                    page_size=pstats["page_size"],
+                )
+                if self.lineage.enabled:
+                    for adm in pstats.get("admissions") or []:
+                        self.lineage.event(
+                            "lease", rollout_index, midloop=True,
+                            row=adm["row"], queue_index=adm["queue_index"],
+                            iteration=adm["iteration"],
+                        )
             self.state["episode"] += cfg.batch_size
             queries = ro["queries"]
             batch_size, context_length = queries.shape
@@ -2003,6 +2054,7 @@ class RLTrainer:
             # the bench payload's pipelining signal
             metrics["time/rollout_overlap_frac"] = meter.overlap_fraction()
             metrics.update(self._spec_decode_metrics(ro.get("spec_stats")))
+            metrics.update(self._paged_metrics(ro.get("paged_stats")))
             if use_orch:
                 ostats = orch.stats()
                 metrics.update({

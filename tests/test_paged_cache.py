@@ -1,0 +1,436 @@
+"""Paged KV cache + continuous batching (sampler/paged/, ISSUE 10).
+
+Pins the acceptance contract: allocator invariants under jit, the paged
+Pallas kernels vs their gather-then-reference XLA oracles (f32 + int8 +
+k-query verify), greedy bit-parity of the monolithic paged layout vs the
+contiguous cache on the CPU mesh (page size dividing AND not dividing the
+logical width), composition with speculative decode / int8 / shared-prefill
+fanout, the continuous-batching scheduler finishing a long-tail corpus in
+STRICTLY fewer decode iterations than the fixed-batch schedule while
+emitting identical greedy rows, and the trainer wiring (rollout/page_*
+metric rows, checkpoint/resume over the paged rollout path).
+
+The long-tail oracle reuses test_speculative's "cycle model": a Markov
+chain over single tokens, so each row's greedy length is constructed by
+hand and the fixed-batch iteration count is analytic (per batch: longest
+row minus one).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.sampler.paged.pages import (
+    PageState, alloc_row, blocks_per_row, full_table, init_page_state,
+    release_row,
+)
+
+EOS, PAD = 3, 0
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(7), jnp.float32)
+    return config, params
+
+
+def _left_pad(rows, T, pad=PAD):
+    ids = np.full((len(rows), T), pad, np.int32)
+    for i, r in enumerate(rows):
+        ids[i, T - len(r):] = r
+    ids = jnp.asarray(ids)
+    return ids, ids != pad
+
+
+def _gen(model, key=0, max_tokens=19, prompts=None, stats=None, **kw):
+    cfg, params = model
+    ids, mask = prompts if prompts is not None else _left_pad(
+        [[5, 6, 7, 8], [PAD, 9, 10], [11, 12, 13, 14]], 5
+    )
+    sp = SamplingParams(max_tokens=max_tokens, **kw)
+    return generate(params, cfg, ids, mask, jax.random.PRNGKey(key), sp,
+                    eos_token_id=EOS, pad_token_id=PAD,
+                    paged_stats_out=stats)
+
+
+# --------------------------------------------------------------------- #
+# allocator: free-list/block-table invariants, fully jitted
+# --------------------------------------------------------------------- #
+
+def test_allocator_invariants_under_jit():
+    N, R, nb = 12, 4, 3
+    alloc = jax.jit(alloc_row)
+    release = jax.jit(release_row)
+    st = init_page_state(N, R, nb)
+    assert int(st.top) == N and (np.asarray(st.table) == N).all()
+
+    # allocate all four rows: every page handed out exactly once
+    for r in range(R):
+        st, ok = alloc(st, r, nb)
+        assert bool(ok)
+    tab = np.asarray(st.table)
+    assert int(st.top) == 0
+    assert sorted(tab.ravel().tolist()) == list(range(N))
+
+    # exhausted pool: ok=False and the state is UNCHANGED
+    st2, ok = alloc(st, 0, 1)
+    assert not bool(ok)
+    np.testing.assert_array_equal(np.asarray(st2.table), tab)
+    assert int(st2.top) == int(st.top)
+
+    # release row 2, realloc into row 1's old slot: the SAME pages come back
+    freed = set(tab[2].tolist())
+    st, m = release(st, 2)
+    assert int(m) == nb and int(st.top) == nb
+    assert (np.asarray(st.table)[2] == N).all()
+    # idempotent: releasing a sentinel row is a no-op
+    st3, m2 = release(st, 2)
+    assert int(m2) == 0 and int(st3.top) == nb
+    st, ok = alloc(st, 2, nb)
+    assert bool(ok) and set(np.asarray(st.table)[2].tolist()) == freed
+
+    # partial allocation (traced n_blocks < nb): sentinel tail on the row
+    st = init_page_state(N, R, nb)
+    st, ok = alloc(st, 1, jnp.int32(2))
+    row = np.asarray(st.table)[1]
+    assert bool(ok) and int(st.top) == N - 2
+    assert (row[:2] < N).all() and row[2] == N
+
+
+def test_blocks_per_row_and_full_table():
+    assert blocks_per_row(24, 8) == 3 and blocks_per_row(25, 8) == 4
+    t = np.asarray(full_table(3, 2))
+    np.testing.assert_array_equal(t, [[0, 1], [2, 3], [4, 5]])
+
+
+# --------------------------------------------------------------------- #
+# paged kernels vs XLA oracles (interpret mode off-TPU)
+# --------------------------------------------------------------------- #
+
+def _scattered_pool(rng, B, KV, hd, P, nb, extra=2):
+    """Pool whose pages are a random permutation (plus one sentinel block),
+    with the logical contiguous view returned for cross-checking."""
+    N = B * nb + extra
+    perm = rng.permutation(N - 1)[: B * nb].reshape(B, nb).astype(np.int32)
+    perm[0, -1] = N                       # released block on row 0
+    T = nb * P
+    k_log = rng.standard_normal((B, KV, T, hd)).astype(np.float32)
+    v_log = rng.standard_normal((B, KV, T, hd)).astype(np.float32)
+    k_pool = np.zeros((N, KV, P, hd), np.float32)
+    v_pool = np.zeros((N, KV, P, hd), np.float32)
+    for b in range(B):
+        for j in range(nb):
+            if perm[b, j] < N:
+                k_pool[perm[b, j]] = k_log[b, :, j * P:(j + 1) * P, :]
+                v_pool[perm[b, j]] = v_log[b, :, j * P:(j + 1) * P, :]
+    return (jnp.asarray(perm), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            N, T)
+
+
+def test_paged_decode_kernel_matches_oracle(rng):
+    from nanorlhf_tpu.ops.decode_attention import (
+        paged_decode_attention, reference_paged_decode_attention,
+    )
+
+    B, KV, G, hd, P, nb = 3, 2, 4, 16, 8, 5
+    table, k_pool, v_pool, N, T = _scattered_pool(rng, B, KV, hd, P, nb)
+    q = jnp.asarray(rng.standard_normal((B, KV * G, hd)).astype(np.float32))
+    start = jnp.asarray([0, 3, 9], jnp.int32)
+    filled = jnp.asarray([17, 30, 25], jnp.int32)  # row0 below its sentinel
+    want = reference_paged_decode_attention(q, k_pool, v_pool, table, start,
+                                            filled)
+    got = paged_decode_attention(q, k_pool, v_pool, table, start, filled,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_q8_kernel_matches_oracle(rng):
+    from nanorlhf_tpu.ops.decode_attention import (
+        paged_decode_attention_q8, reference_paged_decode_attention_q8,
+    )
+
+    B, KV, G, hd, P, nb = 3, 2, 4, 16, 8, 4
+    table, _, _, N, T = _scattered_pool(rng, B, KV, hd, P, nb)
+    q = jnp.asarray(rng.standard_normal((B, KV * G, hd)).astype(np.float32))
+    kq = jnp.asarray(rng.integers(-127, 127, (N, KV, P, hd)).astype(np.int8))
+    vq = jnp.asarray(rng.integers(-127, 127, (N, KV, P, hd)).astype(np.int8))
+    ks = jnp.asarray(rng.uniform(0.005, 0.02, (N, KV, 8, P)).astype(np.float32))
+    vs = jnp.asarray(rng.uniform(0.005, 0.02, (N, KV, 8, P)).astype(np.float32))
+    start = jnp.asarray([0, 2, 7], jnp.int32)
+    filled = jnp.asarray([13, 24, 19], jnp.int32)
+    want = reference_paged_decode_attention_q8(q, kq, ks, vq, vs, table,
+                                               start, filled)
+    got = paged_decode_attention_q8(q, kq, ks, vq, vs, table, start, filled,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_verify_kernel_matches_oracle(rng):
+    from nanorlhf_tpu.ops.decode_attention import (
+        paged_decode_verify_attention,
+        reference_paged_decode_verify_attention,
+    )
+
+    B, KV, G, hd, P, nb, Tq = 3, 2, 4, 16, 8, 5, 4
+    table, k_pool, v_pool, N, T = _scattered_pool(rng, B, KV, hd, P, nb)
+    q = jnp.asarray(
+        rng.standard_normal((B, KV * G, Tq, hd)).astype(np.float32))
+    start = jnp.asarray([0, 3, 9], jnp.int32)
+    fill = jnp.asarray([10, 22, 15], jnp.int32)   # row 1 straddles a page
+    want = reference_paged_decode_verify_attention(q, k_pool, v_pool, table,
+                                                   start, fill)
+    got = paged_decode_verify_attention(q, k_pool, v_pool, table, start,
+                                        fill, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+# --------------------------------------------------------------------- #
+# monolithic paged layout: bit-parity with the contiguous cache
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("page_size", [8, 5])  # 8 | 24 = Tp+max_tokens; 5 ∤
+def test_greedy_paged_bit_identical(tiny, page_size):
+    mono = _gen(tiny, greedy=True)
+    paged = _gen(tiny, greedy=True, page_size=page_size)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(paged))
+
+
+def test_paged_capture_logprobs_bit_identical(tiny):
+    mt, mlp = _gen(tiny, greedy=True, capture_logprobs=True)
+    pt, plp = _gen(tiny, greedy=True, capture_logprobs=True, page_size=8)
+    np.testing.assert_array_equal(np.asarray(mt), np.asarray(pt))
+    np.testing.assert_array_equal(np.asarray(mlp), np.asarray(plp))
+
+
+def test_paged_int8_kv_cache_bit_identical(tiny):
+    cfg, params = tiny
+    q_model = (dataclasses.replace(cfg, kv_cache_quant="int8"), params)
+    mono = _gen(q_model, greedy=True)
+    for P in (8, 5):
+        paged = _gen(q_model, greedy=True, page_size=P)
+        np.testing.assert_array_equal(np.asarray(mono), np.asarray(paged))
+
+
+def test_paged_spec_matches_monolithic(tiny):
+    """spec_k composes with page_size: paged verify writes land through the
+    block table and the greedy stream still equals the plain monolithic
+    loop (greedy spec is bit-exact, paged is a pure re-layout)."""
+    mono = _gen(tiny, greedy=True)
+    for P in (8, 5):
+        paged = _gen(tiny, greedy=True, spec_k=3, page_size=P)
+        np.testing.assert_array_equal(np.asarray(mono), np.asarray(paged))
+
+
+def test_paged_shared_prefill_fanout_bit_identical(tiny):
+    prompts = _left_pad([[5, 6, 7], [9, 10, 11]], 4)
+    mono = _gen(tiny, greedy=True, n=2, prompts=prompts)
+    paged = _gen(tiny, greedy=True, n=2, page_size=8, prompts=prompts)
+    assert paged.shape == (4, 19)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(paged))
+
+
+def test_paged_sampled_stream_bit_identical(tiny):
+    """Sampled (non-greedy) monolithic paged: the logits are bit-identical,
+    so the SAME key draws the SAME stream."""
+    mono = _gen(tiny, key=11, temperature=0.9)
+    paged = _gen(tiny, key=11, temperature=0.9, page_size=8)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(paged))
+
+
+def test_paged_with_compaction_raises(tiny):
+    with pytest.raises(ValueError, match="page_size"):
+        _gen(tiny, page_size=8, compaction_segments=2)
+
+
+def test_cache_extra_gated_to_contiguous(tiny):
+    """The spec path's cache_extra slack must NOT inflate the paged pool:
+    pool pages == B * ceil((Tp+max_tokens)/P) exactly, slack or not —
+    over-budget verify writes drop at the table-routed scatter instead."""
+    from nanorlhf_tpu.sampler.sampler import _prefill_state
+
+    cfg, params = tiny
+    ids, mask = _left_pad([[5, 6, 7, 8]], 5)
+    kw = dict(max_tokens=7, eos_token_id=EOS, pad_token_id=PAD,
+              temperature=1.0, top_p=0.95, greedy=True, lora_scale=1.0,
+              top_k=64, capture_logprobs=False, approx_top_k=True)
+    P = 4
+    nb = blocks_per_row(5 + 7, P)
+    state = _prefill_state(params, cfg, ids, mask, jax.random.PRNGKey(0),
+                           cache_extra=3, page_size=P, **kw)
+    assert state[3][0].shape[1] == 1 * nb       # pool pages, NO slack
+    assert state[4].shape[1] == 5 + 7           # key_mask width, NO slack
+    contig = _prefill_state(params, cfg, ids, mask, jax.random.PRNGKey(0),
+                            cache_extra=3, **kw)
+    assert contig[4].shape[1] == 5 + 7 + 3      # contiguous keeps the slack
+
+
+# --------------------------------------------------------------------- #
+# continuous batching: long-tail corpus, strictly fewer iterations
+# --------------------------------------------------------------------- #
+
+def _chain_model():
+    """Markov chains: v -> v+1 -> ... -> 30 -> EOS, so a prompt ending in
+    token v generates exactly (30 - v) + 1 tokens greedily. Long-tail
+    lengths are then just a choice of start tokens."""
+    from tests.test_speculative import cycle_model
+
+    sigma = list(range(32))
+    for t in range(10, 30):
+        sigma[t] = t + 1
+    sigma[30] = EOS
+    return cycle_model(sigma, vocab=32)
+
+
+def _chain_prompts(starts, Tp=2):
+    return _left_pad([[9, v] for v in starts], Tp)
+
+
+def test_queued_long_tail_fewer_iterations_same_tokens():
+    """The acceptance gate: one straggler per R-row wave. The fixed-batch
+    schedule pays (longest row - 1) decode iterations PER WAVE; the
+    scheduler backfills finished rows mid-loop and must land strictly
+    under that — while emitting exactly the monolithic greedy rows."""
+    model = _chain_model()
+    # lengths 20, 3, 18, 4, 16, 3, 14, 5 (start v -> 31 - v tokens)
+    starts = [11, 28, 13, 27, 15, 28, 17, 26]
+    lengths = [31 - v for v in starts]
+    prompts = _chain_prompts(starts)
+    R, max_tokens = 2, 24
+
+    mono = _gen(model, greedy=True, max_tokens=max_tokens, prompts=prompts)
+    stats = []
+    queued = _gen(model, greedy=True, max_tokens=max_tokens, prompts=prompts,
+                  page_size=4, decode_rows=R, stats=stats)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(queued))
+
+    # analytic fixed-batch schedule at the same resident-batch size R
+    fixed_iters = sum(max(lengths[i:i + R]) - 1
+                      for i in range(0, len(lengths), R))
+    st = stats[0]
+    assert st["decode_iterations"] < fixed_iters, (
+        f"queued {st['decode_iterations']} >= fixed {fixed_iters}")
+    assert st["admitted_midloop"] == len(starts) - R
+    assert st["pages_recycled"] > 0
+    assert 0.0 < st["page_utilization"] <= 1.0
+    # every admission names a valid resident row and queue entry
+    for adm in st["admissions"]:
+        assert 0 <= adm["row"] < R
+        assert R <= adm["queue_index"] < len(starts)
+
+
+def test_queued_spec_composes_and_matches():
+    """spec_k over the recycled pool: same greedy rows, and the verify
+    dispatch count lands under the plain queued iteration count on the
+    self-repetitive tail (the drafter pays off mid-queue too)."""
+    from tests.test_speculative import cycle_model
+
+    sigma = list(range(16))
+    sigma[5], sigma[6], sigma[7], sigma[8] = 6, 7, 8, 5   # 4-cycle, no EOS
+    model = cycle_model(sigma)
+    prompts = _left_pad([[5, 6, 7, 8, 5]] * 6, 6)
+    mono = _gen(model, greedy=True, max_tokens=24, prompts=prompts)
+    plain_stats, spec_stats = [], []
+    q_plain = _gen(model, greedy=True, max_tokens=24, prompts=prompts,
+                   page_size=4, decode_rows=2, stats=plain_stats)
+    q_spec = _gen(model, greedy=True, max_tokens=24, prompts=prompts,
+                  page_size=4, decode_rows=2, spec_k=4, stats=spec_stats)
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(q_plain))
+    np.testing.assert_array_equal(np.asarray(mono), np.asarray(q_spec))
+    assert (spec_stats[0]["decode_iterations"]
+            < plain_stats[0]["decode_iterations"])
+
+
+def test_queued_sampled_rows_terminate_and_fill_contract():
+    """Sampled queued rollouts: not bit-pinned (admission re-keys rows),
+    but every row must satisfy the output contract — tokens before the
+    first PAD, nothing after an EOS, shapes exact."""
+    cfg = ModelConfig.qwen2_tiny(vocab_size=64)
+    params = init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    prompts = _left_pad([[5 + i, 6, 7] for i in range(7)], 4)
+    out = _gen((cfg, params), key=5, max_tokens=10, prompts=prompts,
+               temperature=1.0, page_size=4, decode_rows=3)
+    rows = np.asarray(out)
+    assert rows.shape == (7, 10)
+    for r in rows:
+        if EOS in r.tolist():
+            e = r.tolist().index(EOS)
+            assert (r[e + 1:] == PAD).all()
+
+
+# --------------------------------------------------------------------- #
+# trainer wiring: metrics rows + checkpoint/resume over the paged path
+# --------------------------------------------------------------------- #
+
+def _paged_trainer(tmp_path, decode_rows=4):
+    from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+    from nanorlhf_tpu.parallel import MeshConfig
+    from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
+
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=512)
+    tok = ToyTokenizer(vocab_size=512)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.bfloat16)
+    dataset = load_prompt_dataset("synthetic:32", tok, max_prompt_len=16)
+
+    def reward(pmt_and_responses, eos_token):
+        return np.asarray([float(len(s) % 3) for s in pmt_and_responses],
+                          np.float32)
+
+    cfg = RLConfig(
+        algo=AlgoName.GRPO, output_dir=str(tmp_path), response_length=16,
+        sample_n=2, per_device_train_batch_size=1,
+        gradient_accumulation_steps=1, num_mini_batches=1,
+        total_episodes=64, rollout_page_size=4,
+        rollout_decode_rows=decode_rows,
+        use_lora=True, save_steps=1, mesh=MeshConfig(data=-1),
+        report_to="jsonl", logging_steps=1, sentinel=False,
+    )
+    return RLTrainer(cfg, mcfg, tok, params, dataset, reward)
+
+
+def test_trainer_paged_metrics_and_resume(tmp_path):
+    """2-update GRPO smoke over the continuous-batching rollout path: the
+    metrics rows must carry rollout/page_utilization + pages_recycled +
+    admitted_midloop (docs/METRICS.md), /statusz must expose the "pages"
+    section, and a checkpoint/resume must continue training over the same
+    paged path."""
+    import json
+    import os
+
+    trainer = _paged_trainer(tmp_path / "ck")
+    try:
+        trainer.train(num_updates=2)
+        status = trainer._statusz()
+        assert status["pages"] is not None
+        assert status["pages"]["page_size"] == 4
+        assert status["pages"]["page_utilization"] is not None
+        saved_step = trainer.state["global_step"]
+    finally:
+        trainer.close()
+    rows = [json.loads(l) for l in open(
+        os.path.join(str(tmp_path / "ck"), "metrics.jsonl")
+    ) if l.strip()]
+    step_rows = [r for r in rows if "rollout/page_utilization" in r]
+    assert len(step_rows) >= 2
+    for r in step_rows:
+        assert 0.0 < r["rollout/page_utilization"] <= 1.0
+        assert r["rollout/pages_recycled"] >= 0.0
+        assert r["rollout/admitted_midloop"] >= 0.0
+
+    tr2 = _paged_trainer(tmp_path / "ck")
+    try:
+        tr2.resume_from_checkpoint()
+        assert tr2.state["global_step"] == saved_step
+        tr2.train(num_updates=1)
+        assert tr2.state["global_step"] == saved_step + 1
+    finally:
+        tr2.close()
