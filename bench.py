@@ -50,7 +50,10 @@ docs/OBSERVABILITY.md §6),
 BENCH_FLEET_WORKERS (0: >1 also measures the elastic rollout fleet at that
 worker count against the single-producer pipeline at the SAME staleness
 and reports detail.fleet.coordinator_overhead_frac — the lease/reorder
-machinery's cost on the step wall; acceptance < 2%, docs/FLEET.md),
+machinery's cost on the step wall; acceptance < 2%, docs/FLEET.md — plus,
+budget permitting, the same fleet over the loopback RpcTransport and
+detail.fleet.rpc_transport_overhead_frac, the socket framing/codec cost;
+acceptance < 5% at 2 workers, docs/FLEET.md §multi-host),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -754,7 +757,7 @@ def run_bench(jax, init_error):
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
                 telemetry=False, spec_k=None, workers=1, health=True,
-                lineage=False):
+                lineage=False, transport="inprocess"):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -786,6 +789,7 @@ def run_bench(jax, init_error):
             rollout_ahead=ahead and not orchestrator,
             rollout_orchestrator=orchestrator,
             rollout_workers=workers if orchestrator else 1,
+            rollout_transport=transport,
             max_staleness=staleness,
             sentinel=sentinel,
             telemetry=telemetry,
@@ -1111,6 +1115,26 @@ def run_bench(jax, init_error):
                     (fleet_sec - single_sec) / max(single_sec, 1e-9), 4,
                 ),
             }
+            # loopback-RPC transport A/B (docs/FLEET.md §multi-host
+            # acceptance: framing + codec + retry machinery costs < 5% of
+            # step wall at 2 workers): same fleet config, the 3-call seam
+            # now crosses a length-prefixed socket round trip per lease /
+            # completion / weight fetch instead of direct method calls.
+            if budget - (time.time() - _T0) > 1.3 * t_baseline:
+                fleet_rpc = measure(
+                    chosen["rollout_quant"], chosen["kv_cache_quant"], False,
+                    orchestrator=True, staleness=fleet_staleness,
+                    spec_k=chosen.get("rollout_spec_k", 0),
+                    workers=fleet_workers_env, transport="rpc",
+                )
+                rpc_sec = fleet_rpc["sec_per_update_steady"]
+                fleet_detail["rpc_sec_per_update"] = rpc_sec
+                fleet_detail["rpc_overlap_frac"] = fleet_rpc[
+                    "rollout_train_overlap_frac"
+                ]
+                fleet_detail["rpc_transport_overhead_frac"] = round(
+                    (rpc_sec - fleet_sec) / max(fleet_sec, 1e-9), 4,
+                )
         except Exception as e:
             fleet_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 

@@ -23,6 +23,14 @@ from nanorlhf_tpu.orchestrator.fleet import (
     Lease,
     RolloutWorker,
 )
+from nanorlhf_tpu.orchestrator.rpc import (
+    FleetRpcServer,
+    RemoteCoordinator,
+    RpcClient,
+    RpcConfig,
+    RpcTransport,
+    TransportError,
+)
 
 __all__ = [
     "BoundedStalenessQueue",
@@ -30,14 +38,20 @@ __all__ = [
     "FleetCoordinator",
     "FleetExhausted",
     "FleetOrchestrator",
+    "FleetRpcServer",
     "FleetTransport",
     "InProcessTransport",
     "Lease",
     "OverlapMeter",
     "ProducerFailed",
     "QueuedSample",
+    "RemoteCoordinator",
     "RolloutOrchestrator",
     "RolloutWorker",
+    "RpcClient",
+    "RpcConfig",
+    "RpcTransport",
+    "TransportError",
     "VersionedWeightStore",
     "note_ready_async",
 ]

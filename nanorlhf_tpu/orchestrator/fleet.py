@@ -40,9 +40,16 @@ worker-scoped fault sites in resilience/faults.py):
   shrinks. `fleet/reassigned_leases` counts these.
 - *hang / straggle* — lease deadlines derive from an EWMA of sample
   latency (`straggler_factor × ewma × lease_len`); an expired lease is
-  revoked and re-dispatched speculatively — the original worker's
-  in-flight result is still accepted if it lands first (first completion
-  per index wins; late duplicates are dropped, `fleet/duplicate_samples`).
+  revoked and re-dispatched speculatively (first completion per index
+  wins; late duplicates are dropped, `fleet/duplicate_samples`).
+- *partition / split-brain* — every lease carries a monotonically
+  increasing **epoch** (fencing token, = the coordinator's lease
+  sequence at grant). A completion whose epoch is lower than the highest
+  epoch granted for that index is FENCED: the revoked holder — maybe a
+  partitioned worker racing its replacement over a healed link — cannot
+  commit, regardless of arrival order. Fenced completions count as
+  `fleet/fenced_completions` (and duplicates) and emit the
+  `fleet_late_duplicate` lineage drop with `{"fenced": true, "epoch"}`.
 - *flaky* — consecutive in-band failures past `failure_budget` quarantine
   the worker with exponential backoff + jitter (resilience/retry.py — the
   jitter prevents N workers from stampeding the weight store in lockstep);
@@ -114,6 +121,8 @@ class Lease:
     deadline: float
     revoked: bool = False
     reassigned_from: Optional[int] = None  # worker that lost it (if any)
+    epoch: int = 0             # fencing token (coordinator lease sequence
+                               # at grant; higher = granted later)
 
     def __len__(self) -> int:
         return len(self.batches)
@@ -143,6 +152,15 @@ _COUNTERS = (
     "leases_granted", "reassigned_leases", "expired_leases",
     "speculative_dispatches", "worker_failures", "quarantines",
     "worker_joins", "worker_losses", "duplicate_samples",
+    "fenced_completions",
+)
+
+# transport counters merged into stats() when a network transport has
+# registered its provider (FleetRpcServer.transport_info); always present
+# (0.0) so the fleet/rpc_* metric rows exist for every transport
+_TRANSPORT_COUNTERS = (
+    "rpc_retries", "rpc_reconnects", "rpc_rtt_ewma_s",
+    "rpc_bytes_tx", "rpc_bytes_rx", "rpc_errors", "heartbeat_misses",
 )
 
 
@@ -193,6 +211,12 @@ class FleetCoordinator:
         self._ready: dict[int, QueuedSample] = {}
         self._done: set[int] = set()   # completed but not yet emitted
         self._lease_seq = 0
+        # fencing: highest lease epoch granted per rollout index (pruned a
+        # fixed window behind the emit cursor, so late-landing completions
+        # of recently emitted indices still get fenced attribution)
+        self._index_epoch: dict[int, int] = {}
+        self._transport_name = "inprocess"
+        self._transport_info: Optional[Callable[[], dict]] = None
         self._ewma_s = 0.0             # fleet-wide sample latency
         self._rng = random.Random(self.cfg.seed)
         self._closed = False
@@ -237,6 +261,23 @@ class FleetCoordinator:
             if rec is not None:
                 rec.last_heartbeat = self._clock()
 
+    def set_transport(self, name: str,
+                      info_fn: Optional[Callable[[], dict]] = None):
+        """Register the transport's identity + stats provider. `info_fn`
+        (e.g. FleetRpcServer.transport_info) is called under the
+        coordinator lock from stats()/snapshot(); it must only take the
+        transport's own lock and never call back into the coordinator."""
+        with self._cond:
+            self._transport_name = name
+            self._transport_info = info_fn
+
+    @property
+    def current_epoch(self) -> int:
+        """Highest lease epoch granted so far (the fencing high-water
+        mark a reconnecting worker learns in the hello handshake)."""
+        with self._cond:
+            return self._lease_seq
+
     def kick(self):
         """Wake acquire-waiters (a publish or skip-credit may have opened
         the staleness gate)."""
@@ -279,6 +320,36 @@ class FleetCoordinator:
                         or worker_id not in self._workers
                         or self._workers[worker_id].lost):
                     self._waiters.remove(worker_id)
+
+    def acquire_nowait(self, worker_id: int
+                       ) -> tuple[Optional[Lease], bool]:
+        """One non-blocking grant attempt for a REMOTE worker (the RPC
+        server answers `acquire` ops with this; the client polls). Returns
+        (lease, stopped): lease is None when nothing is grantable right
+        now, stopped=True tells the worker to exit its loop. FIFO fairness
+        is preserved — a polling remote worker holds its waiter slot
+        between attempts exactly like a blocked in-process one."""
+        with self._cond:
+            self._poll_locked()
+            rec = self._workers.get(worker_id)
+            if self._closed or rec is None or rec.lost:
+                if worker_id in self._waiters:
+                    self._waiters.remove(worker_id)
+                return None, True
+            if worker_id not in self._waiters:
+                self._waiters.append(worker_id)
+            now = self._clock()
+            if (rec.quarantined_until <= now
+                    and self._head_waiter_locked(now) == worker_id):
+                lease = self._next_work_locked(worker_id, now)
+                if lease is not None:
+                    self._waiters.remove(worker_id)
+                    self._cond.notify_all()
+                    return lease, False
+            # the remote worker sleeps its poll interval client-side; that
+            # wait is this fleet's staleness-gate wait
+            self.gate_wait_s += self.cfg.poll_interval
+            return None, self._closed
 
     def _head_waiter_locked(self, now: float) -> Optional[int]:
         for wid in self._waiters:
@@ -349,10 +420,16 @@ class FleetCoordinator:
         lease = Lease(
             lease_id=self._lease_seq, worker_id=worker_id, start=start,
             batches=batches, issued_at=now, deadline=deadline,
-            reassigned_from=reassigned_from,
+            reassigned_from=reassigned_from, epoch=self._lease_seq,
         )
         self._leases[lease.lease_id] = lease
         self.counters["leases_granted"] += 1
+        for o in range(len(batches)):
+            # fencing high-water mark: a re-grant raises the bar, and any
+            # completion still carrying the old epoch is rejected
+            idx = start + o
+            if lease.epoch > self._index_epoch.get(idx, 0):
+                self._index_epoch[idx] = lease.epoch
         if self._lineage is not None and self._lineage.enabled:
             # one lease event per covered index: the chain for a rollout
             # index joins on rollout_index, and a reassigned lease's second
@@ -361,7 +438,8 @@ class FleetCoordinator:
                 self._lineage.lease(
                     start + o, lease_id=lease.lease_id, worker_id=worker_id,
                     reassigned_from=reassigned_from, cursor=start + o,
-                    length=len(batches),
+                    length=len(batches), transport=self._transport_name,
+                    epoch=lease.epoch,
                 )
         return lease
 
@@ -385,12 +463,26 @@ class FleetCoordinator:
         with self._cond:
             return lease.revoked
 
+    def lease_by_id(self, lease_id: int) -> Optional[Lease]:
+        """The live lease with this id, or None if completed/revoked and
+        pruned (the RPC server resolves completion/failure reports that
+        arrive carrying only the id)."""
+        with self._cond:
+            return self._leases.get(lease_id)
+
+    def lease_active(self, lease_id: int) -> bool:
+        with self._cond:
+            return lease_id in self._leases
+
     def complete(self, worker_id: int, lease: Lease, index: int,
                  sample: QueuedSample) -> bool:
-        """Record a device-ready sample. First completion per index wins —
-        a straggler's late result after speculative re-dispatch is dropped
-        (False). Samples enter the queue strictly in index order via the
-        reorder buffer."""
+        """Record a device-ready sample. A completion commits only when it
+        is the first for its index AND carries the highest epoch granted
+        for that index (the fencing token): a revoked holder — straggler
+        or partitioned worker — cannot commit after its re-dispatch was
+        granted, regardless of arrival order. Rejected completions return
+        False; accepted samples enter the queue strictly in index order
+        via the reorder buffer."""
         with self._cond:
             now = self._clock()
             rec = self._workers.get(worker_id)
@@ -407,16 +499,22 @@ class FleetCoordinator:
                 self.cfg.ewma_alpha * latency
                 + (1 - self.cfg.ewma_alpha) * self._ewma_s
             )
-            if self._index_done_locked(index):
+            epoch = getattr(lease, "epoch", 0)
+            granted = self._index_epoch.get(index)
+            fenced = granted is not None and 0 < epoch < granted
+            if fenced or self._index_done_locked(index):
                 self.counters["duplicate_samples"] += 1
+                if fenced:
+                    self.counters["fenced_completions"] += 1
                 if self._lineage is not None:
-                    # a straggler's result landing after its speculative
-                    # replacement already delivered: the SAMPLES are not
-                    # lost (the winner's are trained on) — the duplicate
-                    # batch is what hits the floor
+                    # a revoked/straggling holder's result losing to its
+                    # replacement: the SAMPLES are not lost (the winner's
+                    # are trained on) — the duplicate batch is what hits
+                    # the floor. `fenced` marks epoch rejections (the
+                    # partition case) vs plain arrival-order losses.
                     self._lineage.drop(
                         index, "fleet_late_duplicate", worker_id=worker_id,
-                        lease_id=lease.lease_id,
+                        lease_id=lease.lease_id, fenced=fenced, epoch=epoch,
                     )
                 self._cond.notify_all()
                 return False
@@ -425,6 +523,9 @@ class FleetCoordinator:
             while self._next_emit in self._ready:
                 self._queue.put(self._ready.pop(self._next_emit))
                 self._done.discard(self._next_emit)
+                # keep a trailing window of epochs so late completions of
+                # just-emitted indices still get fenced attribution
+                self._index_epoch.pop(self._next_emit - 1024, None)
                 self._next_emit += 1
             # sweep EVERY fully-completed lease, not just the one this
             # completion belongs to: after a speculative re-dispatch the
@@ -569,10 +670,18 @@ class FleetCoordinator:
 
     def stats(self) -> dict:
         """Flat numeric snapshot for the `fleet/*` metric rows
-        (docs/METRICS.md)."""
+        (docs/METRICS.md). Transport counters (rpc_retries, heartbeat
+        misses, ...) are always present — zero under InProcessTransport,
+        live values once a network transport registers its provider."""
         with self._cond:
             now = self._clock()
             live = [r for r in self._workers.values() if not r.lost]
+            transport = {k: 0.0 for k in _TRANSPORT_COUNTERS}
+            if self._transport_info is not None:
+                info = self._transport_info()
+                for k, v in (info.get("counters") or {}).items():
+                    if k in transport:
+                        transport[k] = float(v)
             return {
                 "workers": float(len(live)),
                 "workers_quarantined": float(sum(
@@ -580,6 +689,7 @@ class FleetCoordinator:
                 )),
                 "leases_active": float(len(self._leases)),
                 **{k: float(v) for k, v in self.counters.items()},
+                **transport,
             }
 
     def snapshot(self) -> dict:
@@ -589,7 +699,11 @@ class FleetCoordinator:
         or lost, and which leases are in flight against what deadline."""
         with self._cond:
             now = self._clock()
+            per_worker: dict = {}
+            if self._transport_info is not None:
+                per_worker = self._transport_info().get("per_worker") or {}
             return {
+                "transport": self._transport_name,
                 "workers": [
                     {
                         "worker_id": r.worker_id,
@@ -603,6 +717,12 @@ class FleetCoordinator:
                         "samples": r.samples,
                         "ewma_s": round(r.ewma_s, 4),
                         "heartbeat_age_s": round(now - r.last_heartbeat, 3),
+                        # per-worker transport state: connection phase, RTT,
+                        # retries, last fencing epoch seen (rpc); in-process
+                        # workers are trivially "connected"
+                        "transport": per_worker.get(
+                            r.worker_id, {"state": "connected"}
+                        ),
                     }
                     for r in self._workers.values()
                 ],
@@ -615,6 +735,7 @@ class FleetCoordinator:
                         "age_s": round(now - l.issued_at, 3),
                         "deadline_in_s": round(l.deadline - now, 3),
                         "reassigned_from": l.reassigned_from,
+                        "epoch": l.epoch,
                     }
                     for l in self._leases.values()
                 ],
@@ -861,6 +982,13 @@ class FleetOrchestrator:
     coordinator, under its lock, in strict index order, so the data cursor
     semantics (and the checkpoint/resume journal) are exactly the
     single-producer ones. `initial_params` becomes weight version 0.
+
+    `transport` selects the worker↔coordinator seam: "inprocess" (direct
+    calls, the default) or "rpc" (loopback FleetRpcServer + one RpcClient
+    per worker — the same wire path a cross-host deployment uses, so the
+    fault matrix and bit-parity tests cover the network code on CPU CI).
+    `rpc` (an orchestrator.rpc.RpcConfig) carries address/timeout/retry
+    knobs; None = loopback on an ephemeral port.
     """
 
     def __init__(
@@ -879,6 +1007,8 @@ class FleetOrchestrator:
         tracer=None,
         fleet: Optional[FleetConfig] = None,
         lineage=None,
+        transport: str = "inprocess",
+        rpc=None,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers} must be >= 1")
@@ -903,9 +1033,32 @@ class FleetOrchestrator:
         if restore:
             self.queue.restore_counters(restore)
             self.coordinator.restore_counters(restore.get("fleet", {}))
-        self.transport = InProcessTransport(
-            self.store, self.coordinator, dispatch_fn, faults=faults
-        )
+        if transport not in ("inprocess", "rpc"):
+            raise ValueError(
+                f"transport={transport!r}: 'inprocess' | 'rpc'"
+            )
+        self._dispatch_fn = dispatch_fn
+        self._rpc_server = None
+        self._rpc_clients: list = []
+        self._rpc_cfg = None
+        if transport == "rpc":
+            from nanorlhf_tpu.orchestrator import rpc as _rpc
+
+            self._rpc_mod = _rpc
+            self._rpc_cfg = rpc if rpc is not None else _rpc.RpcConfig(
+                poll_interval=self.coordinator.cfg.poll_interval
+            )
+            # the server registers itself as the coordinator's transport
+            # stats provider (set_transport) at construction
+            self._rpc_server = _rpc.FleetRpcServer(
+                self.coordinator, self.store, config=self._rpc_cfg,
+                faults=faults,
+            )
+            self.transport = None  # per-worker RpcTransport instead
+        else:
+            self.transport = InProcessTransport(
+                self.store, self.coordinator, dispatch_fn, faults=faults
+            )
         self._poll = min(heartbeat, self.coordinator.cfg.poll_interval)
         self._workers: list[RolloutWorker] = []
         self._next_worker_id = 0
@@ -924,8 +1077,25 @@ class FleetOrchestrator:
     def _make_worker(self) -> RolloutWorker:
         wid = self._next_worker_id
         self._next_worker_id += 1
+        if self._rpc_server is not None:
+            # worker side of the wire: its own client connection, a proxy
+            # with the coordinator surface, and the 3-call transport —
+            # the worker loop itself is identical to the in-process one
+            client = self._rpc_mod.RpcClient(
+                self._rpc_server.address, wid, config=self._rpc_cfg,
+                faults=self._faults,
+            )
+            self._rpc_clients.append(client)
+            coord = self._rpc_mod.RemoteCoordinator(
+                client, poll_interval=self._rpc_cfg.poll_interval
+            )
+            transport = self._rpc_mod.RpcTransport(
+                client, self._dispatch_fn
+            )
+        else:
+            coord, transport = self.coordinator, self.transport
         w = RolloutWorker(
-            wid, self.coordinator, self.transport, meter=self.meter,
+            wid, coord, transport, meter=self.meter,
             faults=self._faults, tracer=self._tracer, lineage=self._lineage,
         )
         # register BEFORE start: the worker's first acquire must find its
@@ -1018,3 +1188,7 @@ class FleetOrchestrator:
         deadline = time.monotonic() + join_timeout
         for w in self._workers:
             w.join(timeout=max(0.1, deadline - time.monotonic()))
+        for c in self._rpc_clients:
+            c.close()
+        if self._rpc_server is not None:
+            self._rpc_server.close()

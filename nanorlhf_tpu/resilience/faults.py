@@ -32,6 +32,24 @@ orchestrator/fleet.py — each selectable by worker id via `worker=I`):
     worker.fetch_weights worker's weight-store fetch raises (recoverable —
                          counts toward the consecutive-failure quarantine)
 
+Network-layer points (wired inside the RpcTransport framing,
+orchestrator/rpc.py — fired once per frame send on BOTH directions: the
+client's request path and the server's response path, so either direction
+is coverable deterministically with a `worker=I` selector):
+
+    net.drop       the frame is not sent and the connection is closed
+                   (packet loss → reset; the caller's retry/backoff path)
+    net.delay      sleep `delay` seconds before the frame goes out
+                   (latency spike; default action "delay")
+    net.partition  the worker's link goes dead for `delay` seconds — every
+                   call fails fast until it heals (lease expiry + fencing
+                   path; client-side state, default action "partition")
+    net.duplicate  the frame is sent twice (at-least-once delivery; the
+                   receiver's seq/offset dedup must absorb it)
+    net.tear       the frame is truncated mid-payload and the connection
+                   closed — the receiver detects it by length+checksum
+                   (recoverable, counts against the failure budget)
+
 Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
 by ";" or whitespace):
 
@@ -45,7 +63,11 @@ by ";" or whitespace):
     action=A   "raise" (default) raises InjectedFault; "nan" returns "nan"
                from fire() for the caller to poison its observed value;
                "hang"/"delay" return themselves for the fleet worker loop
-               to stall on (worker.* points default to the matching action)
+               to stall on; "drop"/"partition"/"duplicate"/"tear" return
+               themselves for the RPC framing to act on (worker.* and
+               net.* points default to the matching action);
+               "delay"/"partition" return with their duration attached
+               ("delay:<s>" / "partition:<s>")
     worker=I   only fire for calls tagged with this worker id
                (`fire(point, worker=I)`); the call counter then counts
                THAT worker's calls — `at=1,worker=0` is worker 0's first
@@ -53,7 +75,7 @@ by ";" or whitespace):
                Without `worker=`, calls from all workers share one counter
                in arrival order (nondeterministic across threads — fine
                for `every=1`, not for `at=N` assertions).
-    delay=S    seconds for action "delay" (default 1.0)
+    delay=S    seconds for actions "delay" and "partition" (default 1.0)
 
 Examples:
 
@@ -86,13 +108,28 @@ INJECTION_POINTS = frozenset({
     "worker.hang",
     "worker.slow",
     "worker.fetch_weights",
+    # network-layer sites (orchestrator/rpc.py framing)
+    "net.drop",
+    "net.delay",
+    "net.partition",
+    "net.duplicate",
+    "net.tear",
 })
 
-ACTIONS = ("raise", "nan", "hang", "delay")
+ACTIONS = ("raise", "nan", "hang", "delay",
+           "drop", "partition", "duplicate", "tear")
 
 # a bare `worker.hang:at=1` should hang, not raise — the point name IS the
 # intended behavior; an explicit action= still overrides
-_DEFAULT_ACTIONS = {"worker.hang": "hang", "worker.slow": "delay"}
+_DEFAULT_ACTIONS = {
+    "worker.hang": "hang",
+    "worker.slow": "delay",
+    "net.drop": "drop",
+    "net.delay": "delay",
+    "net.partition": "partition",
+    "net.duplicate": "duplicate",
+    "net.tear": "tear",
+}
 
 
 class InjectedFault(RuntimeError):
@@ -220,8 +257,9 @@ class FaultInjector:
                             f" worker {worker}" if worker is not None else ""
                         )
                         raise InjectedFault(point, detail=detail)
-                    if s.action == "delay":
-                        return f"delay:{s.delay}"
+                    if s.action in ("delay", "partition"):
+                        # these carry their duration parameter through
+                        return f"{s.action}:{s.delay}"
                     return s.action
         return None
 

@@ -318,6 +318,15 @@ DEFAULT_RULES: tuple = (
                "rate_above", warn=0.05, crit=0.5,
                description="XLA backend recompiles accruing mid-run (silent "
                            "retraces)"),
+    HealthRule("rpc_error_rate", "fleet/rpc_errors",
+               "rate_above", warn=0.5, crit=2.0,
+               description="fleet RPC transport errors (torn frames, resets, "
+                           "timeouts) accruing per wall second"),
+    HealthRule("heartbeat_miss_rate", "fleet/heartbeat_misses",
+               "rate_above", warn=0.2, crit=1.0,
+               description="worker heartbeats failing to reach the "
+                           "coordinator (link degradation before lease "
+                           "expiry fires)"),
 )
 
 

@@ -196,6 +196,19 @@ class RLConfig:
     # pre-EWMA lease deadline AND heartbeat-silence timeout (seconds): must
     # comfortably exceed a cold-cache first compile
     fleet_initial_deadline: float = 600.0
+    # worker↔coordinator transport seam (orchestrator/rpc.py): "inprocess"
+    # keeps direct calls; "rpc" routes leases/completions/heartbeats/weights
+    # through the length-prefixed binary loopback RPC — the same wire path a
+    # cross-host fleet uses (lease-epoch fencing, retry/backoff, streamed
+    # weight fetch), exercisable on CPU CI. Requires rollout_workers > 1
+    # (the trainer rejects rpc with a single worker — the seam only exists
+    # inside the fleet orchestrator).
+    rollout_transport: str = "inprocess"   # inprocess | rpc
+    fleet_rpc_host: str = "127.0.0.1"      # bind + dial address
+    fleet_rpc_port: int = 0                # 0 = ephemeral (loopback/CI)
+    fleet_rpc_timeout: float = 30.0        # per-attempt socket timeout (s)
+    fleet_rpc_attempts: int = 4            # retry_with_backoff attempts/call
+    fleet_rpc_backoff_base: float = 0.05   # jittered backoff base (s)
 
     # ---- optimization ----
     learning_rate: float = 6e-6

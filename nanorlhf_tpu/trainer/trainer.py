@@ -392,6 +392,17 @@ class RLTrainer:
                 "async pipeline — it requires rollout_orchestrator=True "
                 "(docs/FLEET.md)"
             )
+        if config.rollout_transport not in ("inprocess", "rpc"):
+            raise ValueError(
+                f"rollout_transport={config.rollout_transport!r}: "
+                "inprocess | rpc"
+            )
+        if (config.rollout_transport == "rpc"
+                and config.rollout_workers <= 1):
+            raise ValueError(
+                "rollout_transport='rpc' is the fleet's network seam — it "
+                "requires rollout_workers > 1 (docs/FLEET.md)"
+            )
         if config.offpolicy_correction not in ("truncated_is", "none"):
             raise ValueError(
                 f"offpolicy_correction={config.offpolicy_correction!r}"
@@ -744,6 +755,18 @@ class RLTrainer:
                 from nanorlhf_tpu.orchestrator import FleetOrchestrator
                 from nanorlhf_tpu.orchestrator.fleet import FleetConfig
 
+                rpc_cfg = None
+                if cfg.rollout_transport == "rpc":
+                    from nanorlhf_tpu.orchestrator.rpc import RpcConfig
+
+                    rpc_cfg = RpcConfig(
+                        host=cfg.fleet_rpc_host,
+                        port=cfg.fleet_rpc_port,
+                        call_timeout=cfg.fleet_rpc_timeout,
+                        attempts=cfg.fleet_rpc_attempts,
+                        backoff_base=cfg.fleet_rpc_backoff_base,
+                    )
+
                 def batch_fn():
                     # the COORDINATOR is the sole consumer of the data
                     # iterator (under its lock, in strict index order) and
@@ -789,6 +812,8 @@ class RLTrainer:
                         worker_timeout_s=cfg.fleet_initial_deadline,
                         seed=cfg.seed,
                     ),
+                    transport=cfg.rollout_transport,
+                    rpc=rpc_cfg,
                 )
             else:
                 from nanorlhf_tpu.orchestrator import RolloutOrchestrator

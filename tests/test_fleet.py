@@ -59,8 +59,11 @@ def _metric_rows(outdir):
 
 
 def _fleet(n_workers=2, max_staleness=2, dispatch=None, faults=None,
-           n_batches=1000, **fleet_kw):
-    """FleetOrchestrator over a fake dispatch (no jax, no model)."""
+           n_batches=1000, transport="inprocess", **fleet_kw):
+    """FleetOrchestrator over a fake dispatch (no jax, no model).
+    transport="rpc" routes every lease/completion/heartbeat/weight fetch
+    through the loopback FleetRpcServer — same coordinator, same worker
+    loop, the real wire in between."""
     batches = iter(range(n_batches))
     if dispatch is None:
         def dispatch(index, queries, tree, worker_id):
@@ -70,7 +73,7 @@ def _fleet(n_workers=2, max_staleness=2, dispatch=None, faults=None,
     return FleetOrchestrator(
         dispatch_fn=dispatch, batch_fn=lambda: next(batches),
         initial_params={}, n_workers=n_workers, max_staleness=max_staleness,
-        faults=faults, fleet=FleetConfig(**fleet_kw),
+        faults=faults, fleet=FleetConfig(**fleet_kw), transport=transport,
     )
 
 
@@ -201,16 +204,20 @@ def test_overlap_meter_multiproducer_compaction_exact():
 # ---------------------------------------------------------------------------
 
 
-def test_fleet_grants_in_order_and_respects_staleness_gate():
+@pytest.mark.parametrize("transport", ["inprocess", "rpc"])
+def test_fleet_grants_in_order_and_respects_staleness_gate(transport):
     """Workers race, samples may finish out of order, but consumption is
-    strictly index-ordered and never beyond the staleness bound."""
+    strictly index-ordered and never beyond the staleness bound — over
+    direct calls AND over the loopback RPC wire (ISSUE-11 acceptance: the
+    reorder-buffer test generalizes unchanged)."""
     rng = np.random.default_rng(1)
 
     def dispatch(index, queries, tree, worker_id):
         time.sleep(0.002 + 0.01 * rng.random())  # jittered finish order
         return {"index": index, "worker": worker_id}
 
-    orch = _fleet(n_workers=3, max_staleness=2, dispatch=dispatch)
+    orch = _fleet(n_workers=3, max_staleness=2, dispatch=dispatch,
+                  transport=transport)
     try:
         seen, staleness = [], []
         for step in range(10):
@@ -227,11 +234,13 @@ def test_fleet_grants_in_order_and_respects_staleness_gate():
         orch.close()
 
 
-def test_worker_crash_reassigns_lease_with_same_batches():
+@pytest.mark.parametrize("transport", ["inprocess", "rpc"])
+def test_worker_crash_reassigns_lease_with_same_batches(transport):
     """worker 0 dies on its first dispatch: its lease moves to worker 1
     carrying the SAME cached prompt batch (the data cursor is never
     re-burned), the index stream stays gapless, and the fleet counts the
-    loss + reassignment."""
+    loss + reassignment — identically over the loopback RPC transport
+    (the lease's cached batches round-trip through the wire codec)."""
     dispatched = []  # (index, queries, worker)
 
     def dispatch(index, queries, tree, worker_id):
@@ -241,7 +250,7 @@ def test_worker_crash_reassigns_lease_with_same_batches():
 
     faults = FaultInjector.from_spec("worker.crash:at=1,worker=0")
     orch = _fleet(n_workers=2, max_staleness=0, dispatch=dispatch,
-                  faults=faults)
+                  faults=faults, transport=transport)
     try:
         seen = []
         for step in range(4):
@@ -418,14 +427,20 @@ def serial_rows(tmp_path_factory):
     return _metric_rows(tmp / "grpo")
 
 
-def test_worker_crash_mid_lease_bit_identical_stream(tmp_path, serial_rows):
+@pytest.mark.parametrize("transport", ["inprocess", "rpc"])
+def test_worker_crash_mid_lease_bit_identical_stream(tmp_path, serial_rows,
+                                                     transport):
     """ISSUE-6 acceptance: 2 workers at staleness 0, worker 0 crashes on
     its first lease — the token stream and loss trajectory match the
     synchronous trainer (reassignment replays the same cached batch under
-    the same index-keyed PRNG), and fleet/reassigned_leases >= 1."""
+    the same index-keyed PRNG), and fleet/reassigned_leases >= 1.
+    ISSUE-11 extends the same acceptance over the loopback RPC transport:
+    leases, completions, and weights cross the wire codec and the streams
+    must still be bit-identical."""
     tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=48,
                       save_steps=0, rollout_orchestrator=True,
                       rollout_workers=2, max_staleness=0,
+                      rollout_transport=transport,
                       fault_spec="worker.crash:at=1,worker=0")
     tr.train()
     tr.close()

@@ -1,0 +1,442 @@
+"""Network FleetTransport (nanorlhf_tpu/orchestrator/rpc.py,
+docs/FLEET.md §multi-host) — the ISSUE-11 fault matrix over loopback:
+
+- wire units (jax-free): codec round-trips scalars/containers/ndarrays
+  bit-identically, framing detects torn/corrupt frames by length+checksum,
+  the net.* fault-site grammar parses with the worker/at/every selectors;
+- fencing: a partitioned worker's late completion after lease expiry +
+  re-dispatch is REJECTED by epoch comparison with a
+  `fleet_late_duplicate {"fenced": true}` ledger drop, while the
+  re-dispatched result is bit-identical to the no-fault run;
+- weight streaming: `fetch_weights` round-trips a mixed-dtype param tree
+  over the wire with zero disk writes and bit-identical leaves, and the
+  client's version cache short-circuits unchanged policies;
+- fault matrix: drop / duplicate / tear / delay / partition injected into
+  the framing leave the consumed sample stream bit-identical to the
+  no-fault run (retry/backoff + reconnect + seq/offset dedup absorb them);
+- reconnect: a torn connection re-handshakes (worker id, last epoch, last
+  weight version) and the transport counters surface through
+  `FleetCoordinator.stats()` / `snapshot()` into /statusz;
+- health plane: `rpc_error_rate` + `heartbeat_miss_rate` windowed-rate
+  rules are wired over the fleet/rpc_* counter rows.
+"""
+
+import builtins
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nanorlhf_tpu.orchestrator import (
+    BoundedStalenessQueue,
+    FleetConfig,
+    FleetCoordinator,
+    FleetOrchestrator,
+    QueuedSample,
+    VersionedWeightStore,
+)
+from nanorlhf_tpu.orchestrator import rpc
+from nanorlhf_tpu.resilience import FaultInjector, parse_fault_spec
+
+CFG = rpc.RpcConfig(poll_interval=0.02, call_timeout=5.0,
+                    backoff_base=0.02, backoff_max=0.2)
+
+
+class _Ledger:
+    """Minimal lineage double recording lease/drop events."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def lease(self, index, **kw):
+        self.events.append(("lease", index, kw))
+
+    def drop(self, index, reason, **kw):
+        self.events.append(("drop", index, reason, kw))
+
+
+def _coordinator(lineage=None, clock=None, **fleet_kw):
+    q = BoundedStalenessQueue(100, "wait", start_index=0)
+    batches = iter(range(10000))
+    fleet_kw.setdefault("poll_interval", 0.02)
+    kw = {"lineage": lineage}
+    if clock is not None:
+        kw["clock"] = clock
+    coord = FleetCoordinator(
+        q, lambda: np.asarray([next(batches)]),
+        config=FleetConfig(**fleet_kw), **kw,
+    )
+    return coord, q
+
+
+# ---------------------------------------------------------------------------
+# wire units
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrip_bit_identical():
+    obj = {
+        "none": None, "t": True, "f": False, "i": -42, "big": 2 ** 100,
+        "neg_big": -(2 ** 77), "d": 3.141592653589793, "s": "εποχή",
+        "b": b"\x00\xff", "l": [1, [2, 3]], "tup": (4, "x"),
+        "f32": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "i64": np.asarray([[1, -2], [3, 4]], dtype=np.int64),
+        "u8": np.asarray([255, 0], dtype=np.uint8),
+        "scalar0d": np.asarray(7.5, dtype=np.float64),
+    }
+    dec = rpc.loads(rpc.dumps(obj))
+    assert dec["none"] is None and dec["t"] is True and dec["f"] is False
+    assert dec["i"] == -42 and dec["big"] == 2 ** 100
+    assert dec["neg_big"] == -(2 ** 77)
+    assert dec["d"] == obj["d"] and dec["s"] == obj["s"]
+    assert dec["b"] == obj["b"]
+    assert dec["l"] == [1, [2, 3]] and dec["tup"] == (4, "x")
+    for k in ("f32", "i64", "u8", "scalar0d"):
+        np.testing.assert_array_equal(dec[k], obj[k])
+        assert dec[k].dtype == obj[k].dtype
+    # numpy scalars degrade to python scalars (never silently mis-typed)
+    assert rpc.loads(rpc.dumps(np.float32(1.5))) == 1.5
+    with pytest.raises(TypeError, match="cannot encode"):
+        rpc.dumps(object())
+
+
+def test_framing_detects_torn_and_corrupt_frames():
+    a, b = socket.socketpair()
+    try:
+        rpc.send_frame(a, rpc.dumps({"x": 1}))
+        kind, payload = rpc.recv_frame(b)
+        assert kind == rpc.KIND_OBJ and rpc.loads(payload) == {"x": 1}
+        # corrupt payload bytes behind a valid header -> checksum mismatch
+        good = rpc.dumps({"x": 2})
+        frame = rpc._HEADER.pack(
+            rpc._MAGIC, rpc.KIND_OBJ, len(good),
+            __import__("zlib").crc32(good) & 0xFFFFFFFF,
+        ) + good[:-1] + b"\x00"
+        a.sendall(frame)
+        with pytest.raises(rpc.TornFrame, match="checksum"):
+            rpc.recv_frame(b)
+        # header promising more bytes than ever arrive -> torn mid-frame
+        a.sendall(rpc._HEADER.pack(rpc._MAGIC, rpc.KIND_OBJ, 100, 0) + b"hi")
+        a.close()
+        with pytest.raises(rpc.TornFrame, match="mid-frame"):
+            rpc.recv_frame(b)
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+    # clean EOF at a frame boundary is a different, clean signal
+    c, d = socket.socketpair()
+    c.close()
+    with pytest.raises(rpc.ConnectionClosed):
+        rpc.recv_frame(d)
+    d.close()
+
+
+def test_net_fault_spec_grammar():
+    scheds = parse_fault_spec(
+        "net.drop:at=1,worker=0 net.delay:every=2,delay=0.1 "
+        "net.partition:at=1,delay=0.5 net.duplicate:every=3 net.tear:at=2"
+    )
+    assert [s.point for s in scheds] == [
+        "net.drop", "net.delay", "net.partition", "net.duplicate", "net.tear"
+    ]
+    # each net site defaults to its matching action
+    assert [s.action for s in scheds] == [
+        "drop", "delay", "partition", "duplicate", "tear"
+    ]
+    assert scheds[0].worker == 0
+    # partition carries its duration through fire(), like delay
+    inj = FaultInjector(parse_fault_spec("net.partition:every=1,delay=0.5"))
+    assert inj.fire("net.partition", worker=1) == "partition:0.5"
+
+
+# ---------------------------------------------------------------------------
+# loopback server/client
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_weights_round_trips_bit_identical_with_zero_disk_writes(
+        monkeypatch):
+    coord, _q = _coordinator()
+    store = VersionedWeightStore()
+    tree = {
+        "emb": np.arange(64, dtype=np.float32).reshape(8, 8),
+        "layers": [
+            {"w": np.random.default_rng(0).normal(size=(16, 4)),
+             "b": np.zeros(4, dtype=np.float32)},
+            {"w": np.asarray([1, 2, 3], dtype=np.int32), "b": None},
+        ],
+        "meta": ("frozen", 7),
+    }
+    store.publish(tree)
+    server = rpc.FleetRpcServer(coord, store, config=CFG)
+    # small chunk size forces the multi-chunk streaming path
+    client = rpc.RpcClient(server.address, 0,
+                           config=rpc.RpcConfig(chunk_bytes=64,
+                                                call_timeout=5.0))
+    coord.register_worker(0, alive_fn=lambda: True)
+    # any write-mode open during the fetch would be a disk round-trip —
+    # the reference's weak point this transport exists to remove
+    real_open = builtins.open
+    writes = []
+
+    def spy_open(file, mode="r", *a, **kw):
+        if any(c in str(mode) for c in "wax+"):
+            writes.append((file, mode))
+        return real_open(file, mode, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", spy_open)
+    try:
+        version, got = client.fetch_weights()
+    finally:
+        monkeypatch.setattr(builtins, "open", real_open)
+    assert version == 0 and writes == []
+    np.testing.assert_array_equal(got["emb"], tree["emb"])
+    assert got["emb"].dtype == np.float32
+    np.testing.assert_array_equal(got["layers"][0]["w"],
+                                  tree["layers"][0]["w"])
+    assert got["layers"][0]["w"].dtype == tree["layers"][0]["w"].dtype
+    np.testing.assert_array_equal(got["layers"][1]["w"],
+                                  tree["layers"][1]["w"])
+    assert got["layers"][1]["b"] is None
+    assert got["meta"] == ("frozen", 7)
+    # version cache: an unchanged policy is one tiny round trip, the SAME
+    # tree object comes back
+    v2, got2 = client.fetch_weights()
+    assert v2 == 0 and got2 is got
+    # a publish invalidates it
+    store.publish({"emb": tree["emb"] * 2})
+    v3, got3 = client.fetch_weights()
+    assert v3 == 1 and got3 is not got
+    np.testing.assert_array_equal(got3["emb"], tree["emb"] * 2)
+    client.close()
+    server.close()
+    coord.close()
+
+
+def test_partition_fencing_drops_late_completion_with_ledger_event():
+    """ISSUE-11 acceptance: worker A is partitioned holding a lease; the
+    deadline revokes + re-dispatches to B at a higher epoch. A's late
+    completion over the healed link arrives FIRST and must be fenced (epoch
+    comparison, not arrival order) with a `fleet_late_duplicate
+    {"fenced": true, "epoch": ...}` drop, while B's re-dispatched result —
+    bit-identical to the no-fault dispatch — is the one consumed."""
+    led = _Ledger()
+    clockv = [0.0]
+    coord, q = _coordinator(lineage=led, clock=lambda: clockv[0],
+                            initial_deadline_s=0.1)
+    store = VersionedWeightStore()
+    store.publish({"w": np.zeros(2)})
+    server = rpc.FleetRpcServer(coord, store, config=CFG)
+    coord.register_worker(0, alive_fn=lambda: True)
+    coord.register_worker(1, alive_fn=lambda: True)
+    ca = rpc.RpcClient(server.address, 0, config=CFG)
+    cb = rpc.RpcClient(server.address, 1, config=CFG)
+    ra = rpc.RemoteCoordinator(ca, 0.02)
+    rb = rpc.RemoteCoordinator(cb, 0.02)
+    stop = threading.Event()
+
+    def gen(index, queries):  # deterministic "generation" keyed by index
+        return {"tok": np.asarray(queries) * 10 + index}
+
+    la = ra.acquire(0, stop)
+    assert la is not None and la.epoch == 1
+    payload_a = gen(la.start, la.batches[0])  # A computes, then partitions
+    clockv[0] = 1.0                           # lease deadline passes
+    coord.poll()                              # revoke -> reassignment pool
+    lb = rb.acquire(1, stop)                  # B re-granted, higher epoch
+    assert lb is not None and lb.start == la.start and lb.epoch > la.epoch
+    # the lease ledger events carry transport + epoch (ISSUE-11 satellite)
+    lease_evs = [e for e in led.events if e[0] == "lease"]
+    assert [kw["epoch"] for _, _, kw in lease_evs] == [1, 2]
+    assert all(kw["transport"] == "rpc" for _, _, kw in lease_evs)
+    # A's link heals; its completion arrives BEFORE B's — fenced anyway
+    assert ra.complete(0, la, la.start,
+                       QueuedSample(la.start, 0, payload_a, 0.0, 0.1)) is False
+    payload_b = gen(lb.start, lb.batches[0])
+    assert rb.complete(1, lb, lb.start,
+                       QueuedSample(lb.start, 0, payload_b, 0.0, 0.1)) is True
+    s = q.get(timeout=2)
+    # the consumed result is bit-identical to the no-fault dispatch (same
+    # cached batch, same index-keyed computation)
+    np.testing.assert_array_equal(s.payload["tok"], gen(0, la.batches[0])["tok"])
+    drops = [e for e in led.events if e[0] == "drop"]
+    assert len(drops) == 1
+    _, idx, reason, kw = drops[0]
+    assert idx == la.start and reason == "fleet_late_duplicate"
+    assert kw["fenced"] is True and kw["epoch"] == la.epoch
+    assert kw["worker_id"] == 0 and kw["lease_id"] == la.lease_id
+    assert coord.counters["fenced_completions"] == 1
+    assert coord.counters["duplicate_samples"] == 1
+    ca.close()
+    cb.close()
+    server.close()
+    coord.close()
+
+
+def test_reconnect_rehandshakes_and_counts():
+    """A torn connection is recoverable: the client reconnects, re-sends
+    the hello handshake (worker id, last epoch, last weight version), and
+    the retry/reconnect counters surface through coordinator stats."""
+    coord, q = _coordinator()
+    store = VersionedWeightStore()
+    store.publish({"w": np.arange(4.0)})
+    server = rpc.FleetRpcServer(coord, store, config=CFG)
+    coord.register_worker(0, alive_fn=lambda: True)
+    faults = FaultInjector.from_spec("net.tear:at=2,worker=0")
+    client = rpc.RpcClient(server.address, 0, config=CFG, faults=faults)
+    rc = rpc.RemoteCoordinator(client, 0.02)
+    # call 1 = hello, call 2 = acquire -> torn mid-frame, retried on a
+    # fresh connection after a re-handshake
+    lease = rc.acquire(0, threading.Event())
+    assert lease is not None
+    assert client.retries >= 1 and client.reconnects >= 1
+    st = coord.stats()
+    assert st["rpc_retries"] >= 1.0 and st["rpc_reconnects"] >= 1.0
+    assert st["rpc_bytes_tx"] > 0.0
+    # the healed connection still carries a full completion round trip
+    assert rc.complete(0, lease, lease.start, QueuedSample(
+        lease.start, 0, {"t": np.asarray([1])}, 0.0, 0.1)) is True
+    assert q.get(timeout=2).index == lease.start
+    client.close()
+    server.close()
+    coord.close()
+
+
+def test_heartbeat_miss_counted_not_fatal():
+    """Heartbeats over a partitioned link are COUNTED, never raised — real
+    worker silence surfaces through lease expiry, not heartbeat failure."""
+    coord, _q = _coordinator()
+    store = VersionedWeightStore()
+    store.publish({})
+    server = rpc.FleetRpcServer(coord, store, config=CFG)
+    coord.register_worker(0, alive_fn=lambda: True)
+    faults = FaultInjector.from_spec("net.partition:at=1,worker=0,delay=0.2")
+    client = rpc.RpcClient(server.address, 0, config=CFG, faults=faults)
+    transport = rpc.RpcTransport(client, lambda i, q_, t, w: {})
+    transport.heartbeat(0)  # partition fires: miss counted, no exception
+    assert client.heartbeat_misses == 1
+    assert client.stats_payload()["partitioned"] is True
+    time.sleep(0.25)        # window passes; the next heartbeat lands and
+    transport.heartbeat(0)  # reports the miss count to the coordinator
+    assert client.heartbeat_misses == 1
+    assert coord.stats()["heartbeat_misses"] == 1.0
+    client.close()
+    server.close()
+    coord.close()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator-level fault matrix (the CI `fleet-rpc-fault-matrix` step)
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet(transport, faults=None, n=16, **fleet_kw):
+    batches = iter(range(10000))
+
+    def dispatch(index, queries, tree, worker_id):
+        time.sleep(0.002)
+        return {"tok": np.asarray(queries) * 10 + index,
+                "w0": float(tree["w"][0])}
+
+    fleet_kw.setdefault("poll_interval", 0.02)
+    orch = FleetOrchestrator(
+        dispatch_fn=dispatch, batch_fn=lambda: np.asarray([next(batches)]),
+        initial_params={"w": np.asarray([3.0])}, n_workers=2,
+        max_staleness=100, faults=faults, fleet=FleetConfig(**fleet_kw),
+        transport=transport,
+    )
+    out = []
+    try:
+        for _ in range(n):
+            s = orch.get()
+            out.append((s.index, int(s.payload["tok"][0]),
+                        s.payload["w0"]))
+    finally:
+        orch.close()
+    return out, orch
+
+
+@pytest.mark.parametrize("spec", [
+    None,
+    "net.drop:at=3",
+    "net.duplicate:every=2",
+    "net.tear:at=4",
+    "net.delay:every=5,delay=0.05",
+    "net.partition:at=2,worker=0,delay=0.3",
+])
+def test_rpc_fault_matrix_streams_bit_identical(spec):
+    """Every injected network failure mode — lost frames, duplicated
+    frames, torn frames, latency spikes, a partitioned worker — leaves the
+    consumed sample stream bit-identical to the in-process no-fault run:
+    retry/backoff, reconnect + re-handshake, seq/offset dedup, lease
+    re-dispatch, and epoch fencing absorb all of it."""
+    baseline, _ = _run_fleet("inprocess")
+    faults = FaultInjector.from_spec(spec) if spec else None
+    got, orch = _run_fleet("rpc", faults=faults,
+                           initial_deadline_s=0.5 if spec else 600.0)
+    assert got == baseline
+    if spec:
+        fired = sum(v["fires"] for v in faults.stats().values())
+        assert fired >= 1, f"{spec} never fired"
+
+
+def test_statusz_snapshot_carries_transport_state():
+    _, orch = _run_fleet("rpc", n=4)
+    # the orchestrator is closed but the snapshot machinery still reads —
+    # exactly what /statusz does from its HTTP thread
+    snap = orch.status_snapshot()
+    fleet = snap["fleet"]
+    assert fleet["transport"] == "rpc"
+    by_id = {w["worker_id"]: w for w in fleet["workers"]}
+    assert set(by_id) == {0, 1}
+    for w in by_id.values():
+        t = w["transport"]
+        assert t["state"] in ("connected", "reconnecting", "partitioned")
+        assert t["rtt_ewma_s"] >= 0.0
+        assert {"retries", "reconnects", "heartbeat_misses",
+                "last_epoch", "bytes_tx", "bytes_rx"} <= set(t)
+    assert any(w["transport"]["last_epoch"] > 0 for w in by_id.values())
+    # flat stats grow the fleet/rpc_* rows for METRICS.md / the exporter
+    st = orch.fleet_stats()
+    assert {"rpc_retries", "rpc_reconnects", "rpc_rtt_ewma_s",
+            "rpc_bytes_tx", "rpc_bytes_rx", "rpc_errors",
+            "heartbeat_misses", "fenced_completions"} <= set(st)
+    assert st["rpc_bytes_tx"] > 0.0 and st["rpc_rtt_ewma_s"] > 0.0
+    # the inprocess fleet reports the same keys, zeroed
+    _, orch2 = _run_fleet("inprocess", n=2)
+    st2 = orch2.fleet_stats()
+    assert st2["rpc_bytes_tx"] == 0.0 and st2["rpc_retries"] == 0.0
+    assert orch2.status_snapshot()["fleet"]["transport"] == "inprocess"
+    assert orch2.status_snapshot()["fleet"]["workers"][0]["transport"] == {
+        "state": "connected"
+    }
+
+
+def test_health_rules_cover_rpc_errors_and_heartbeat_misses():
+    from nanorlhf_tpu.telemetry.health import (
+        DEFAULT_RULES, HealthMonitor,
+    )
+
+    by_name = {r.name: r for r in DEFAULT_RULES}
+    assert by_name["rpc_error_rate"].metric == "fleet/rpc_errors"
+    assert by_name["rpc_error_rate"].kind == "rate_above"
+    assert by_name["heartbeat_miss_rate"].metric == "fleet/heartbeat_misses"
+    assert by_name["heartbeat_miss_rate"].kind == "rate_above"
+    # the monitor builds windowed rates for both counters and trips CRIT
+    # on a sustained error burst
+    clock = [0.0]
+    mon = HealthMonitor(clock=lambda: clock[0])
+    assert {"fleet/rpc_errors", "fleet/heartbeat_misses"} <= set(mon._rates)
+    errs = 0.0
+    for i in range(12):
+        clock[0] += 1.0
+        errs += 5.0  # 5 errors/s >> crit=2/s
+        mon.observe(i, {"fleet/rpc_errors": errs})
+    assert mon.snapshot()["rules"]["rpc_error_rate"] == "crit"
