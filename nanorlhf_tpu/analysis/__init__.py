@@ -1,0 +1,18 @@
+"""nanolint — project-invariant static analysis + lock-order audit.
+
+This package is deliberately jax-free and stdlib-only so the CLI
+(`tools/nanolint.py`) and the runtime lock-order sanitizer
+(`lockorder.make_lock`, enabled via ``NANORLHF_LOCK_CHECK=1``) can be
+imported from any module — including the jax-free telemetry layer —
+without dragging in heavy dependencies.
+
+Modules:
+  engine      Finding model, allowlist annotations, baseline workflow.
+  determinism Rule family 1: wall-clock / unseeded-RNG / PRNG key reuse.
+  jitpurity   Rule family 2: host syncs + traced-value branches under jit.
+  registry    Rule family 3: fault-site / metric / health-rule cross-checks.
+  lockgraph   Rule family 4: static lock-acquisition graph extraction.
+  lockorder   Declared partial order + instrumented OrderedLock runtime.
+
+See docs/STATIC_ANALYSIS.md for the rule catalog.
+"""

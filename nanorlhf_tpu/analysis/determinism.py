@@ -1,0 +1,289 @@
+"""Rule family 1 — determinism.
+
+Replayability (lineage ledger, bit-parity fleet tests) and the
+straggler-deadline EWMA both require that nothing nondeterministic or
+NTP-steppable leaks into rollout/trainer/orchestrator control flow:
+
+  determinism.wall-clock      time.time() in scoped paths. Wall clock is
+                              legal only for provenance stamps (lineage
+                              record times, metrics rows) via an
+                              allowlist annotation; anything feeding
+                              durations, EWMAs, deadlines, or intervals
+                              must use time.perf_counter()/monotonic()
+                              (the PhaseTimer NTP-step fix from PR 4).
+  determinism.unseeded-random random.* / np.random.* module-state draws.
+                              All sampling randomness flows through
+                              fold_in-derived jax.random keys; the only
+                              sanctioned stdlib-RNG use is a locally
+                              constructed random.Random(seed).
+  determinism.key-reuse       the same jax.random key variable consumed
+                              by two draws with no intervening
+                              split/fold_in/reassignment.
+
+Scope for the clock/RNG rules: orchestrator/, trainer/, sampler/ (the
+paths that feed PRNG, latency EWMAs, and lease deadlines). The
+telemetry layer is out of scope by design — its timestamps are
+provenance by definition and its rows carry both time and t_mono.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Project, dotted_name
+
+SCOPE_PREFIXES = (
+    "nanorlhf_tpu/orchestrator/",
+    "nanorlhf_tpu/trainer/",
+    "nanorlhf_tpu/sampler/",
+)
+
+# jax.random callables that *derive* new keys rather than consuming
+# entropy for a draw; using the source key again after these is the
+# documented idiom (split) or a no-op on the key (fold_in returns new).
+_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "clone", "wrap_key_data"}
+
+
+class _SiteCounter:
+    """Stable per-function ordinals so details survive line churn."""
+
+    def __init__(self):
+        self._counts: dict[tuple[str, str], int] = {}
+
+    def detail(self, what: str, func: str) -> str:
+        n = self._counts.get((what, func), 0)
+        self._counts[(what, func)] = n + 1
+        suffix = f"#{n}" if n else ""
+        return f"{what} in {func}{suffix}"
+
+
+class _DetVisitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, in_scope: bool):
+        self.relpath = relpath
+        self.in_scope = in_scope
+        self.findings: list[Finding] = []
+        self._func_stack: list[str] = ["<module>"]
+        self._sites = _SiteCounter()
+
+    @property
+    def _func(self) -> str:
+        return self._func_stack[-1]
+
+    def _visit_def(self, node):
+        name = (self._func_stack[-1] + "." + node.name
+                if self._func_stack[-1] != "<module>" else node.name)
+        self._func_stack.append(name)
+        if self.in_scope:
+            self._scan_key_reuse(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_ClassDef(self, node):
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_Call(self, node: ast.Call):
+        if self.in_scope:
+            name = dotted_name(node.func)
+            if name == "time.time":
+                self.findings.append(Finding(
+                    rule="determinism.wall-clock", path=self.relpath,
+                    line=node.lineno,
+                    detail=self._sites.detail("time.time", self._func),
+                    message="time.time() in a rollout/orchestrator path; "
+                            "use time.perf_counter() for anything feeding "
+                            "durations/EWMAs/deadlines, or annotate "
+                            "`# nanolint: allow[determinism.wall-clock] "
+                            "<why this is provenance>`",
+                ))
+            elif name and (name.startswith("random.")
+                           or name.startswith("np.random.")
+                           or name.startswith("numpy.random.")):
+                # locally *seeded* generators are the sanctioned stdlib/numpy
+                # form: random.Random(seed), np.random.default_rng(seed)
+                ctor = name.split(".")[-1]
+                if ctor in ("Random", "default_rng", "RandomState") \
+                        and (node.args or node.keywords):
+                    self.generic_visit(node)
+                    return
+                self.findings.append(Finding(
+                    rule="determinism.unseeded-random", path=self.relpath,
+                    line=node.lineno,
+                    detail=self._sites.detail(name, self._func),
+                    message=f"{name}() draws from module-level RNG state; "
+                            "route randomness through fold_in-derived "
+                            "jax.random keys or a locally seeded "
+                            "random.Random(seed)",
+                ))
+        self.generic_visit(node)
+
+    # -- PRNG key reuse -------------------------------------------------
+    def _scan_key_reuse(self, func_node):
+        """Branch-aware source-order scan of one function body.
+
+        Dirty state (key var -> first-draw line) threads through
+        straight-line code; If branches are analyzed independently and
+        merged as the union of the fall-through branches (a branch
+        ending in return/raise/break/continue can't flow past the If,
+        so exclusive-branch draws never alias). Loop bodies are scanned
+        once — cross-iteration reuse with a rebound key is the normal
+        fold_in idiom and is not flagged.
+        """
+        self._reuse_block(func_node.body, {})
+
+    def _stmt_events(self, stmt) -> list[tuple[str, str, int]]:
+        """(kind, var, line) events of one statement, nested blocks excluded."""
+        events: list[tuple[str, str, int]] = []
+
+        def walk_expr(n):
+            for child in ast.walk(n):
+                if isinstance(child, ast.Call):
+                    name = dotted_name(child.func)
+                    if not name:
+                        continue
+                    parts = name.split(".")
+                    is_jr = ((len(parts) == 3 and parts[:2] == ["jax", "random"])
+                             or (len(parts) == 2
+                                 and parts[0] in ("jrandom", "jrnd", "jr")))
+                    if is_jr and child.args and \
+                            isinstance(child.args[0], ast.Name):
+                        kind = ("derive" if parts[-1] in _DERIVERS else "draw")
+                        events.append((kind, child.args[0].id, child.lineno))
+                elif isinstance(child, ast.NamedExpr):
+                    events.append(("bind", child.target.id, child.lineno))
+
+        if isinstance(stmt, ast.Assign):
+            walk_expr(stmt.value)
+            for t in stmt.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        events.append(("bind", leaf.id, stmt.lineno))
+        elif isinstance(stmt, ast.AugAssign):
+            walk_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                events.append(("bind", stmt.target.id, stmt.lineno))
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                walk_expr(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                events.append(("bind", stmt.target.id, stmt.lineno))
+        elif isinstance(stmt, (ast.Expr, ast.Return)):
+            if getattr(stmt, "value", None) is not None:
+                walk_expr(stmt.value)
+        return events
+
+    def _apply_events(self, events, dirty):
+        for kind, var, lineno in events:
+            if kind == "draw":
+                if var in dirty:
+                    self.findings.append(Finding(
+                        rule="determinism.key-reuse", path=self.relpath,
+                        line=lineno,
+                        detail=self._sites.detail(f"key-reuse:{var}",
+                                                  self._func),
+                        message=f"jax.random key {var!r} consumed again "
+                                f"(first draw at line {dirty[var]}) with no "
+                                f"intervening split/fold_in/reassignment — "
+                                f"reused keys produce correlated samples",
+                    ))
+                else:
+                    dirty[var] = lineno
+            else:  # bind or derive clears the reuse hazard
+                dirty.pop(var, None)
+
+    def _reuse_block(self, body, dirty) -> tuple[dict, bool]:
+        """Returns (dirty-out, terminated) for one statement list."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue)):
+                self._apply_events(self._stmt_events(stmt), dirty)
+                return dirty, True
+            if isinstance(stmt, ast.If):
+                self._apply_events(self._stmt_events_expr(stmt.test), dirty)
+                d1, t1 = self._reuse_block(stmt.body, dict(dirty))
+                d2, t2 = self._reuse_block(stmt.orelse, dict(dirty))
+                merged: dict[str, int] = {}
+                for d, t in ((d1, t1), (d2, t2)):
+                    if not t:
+                        merged.update(d)
+                dirty = merged
+                if t1 and t2:
+                    return dirty, True
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                for leaf in ast.walk(stmt.target):
+                    if isinstance(leaf, ast.Name):
+                        dirty.pop(leaf.id, None)
+                d1, _ = self._reuse_block(stmt.body, dict(dirty))
+                d2, _ = self._reuse_block(stmt.orelse, dict(dirty))
+                dirty = {**dirty, **d1, **d2}
+            elif isinstance(stmt, ast.While):
+                d1, _ = self._reuse_block(stmt.body, dict(dirty))
+                d2, _ = self._reuse_block(stmt.orelse, dict(dirty))
+                dirty = {**dirty, **d1, **d2}
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                dirty, term = self._reuse_block(stmt.body, dirty)
+                if term:
+                    return dirty, True
+            elif isinstance(stmt, ast.Try):
+                d1, t1 = self._reuse_block(stmt.body, dict(dirty))
+                merged = dict(dirty) if not t1 else {}
+                if not t1:
+                    merged.update(d1)
+                for h in stmt.handlers:
+                    dh, th = self._reuse_block(h.body, dict(dirty))
+                    if not th:
+                        merged.update(dh)
+                dirty = merged
+                dirty, term = self._reuse_block(stmt.finalbody, dirty)
+                if term:
+                    return dirty, True
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                pass  # nested scopes are scanned separately
+            else:
+                self._apply_events(self._stmt_events(stmt), dirty)
+        return dirty, False
+
+    def _stmt_events_expr(self, expr):
+        fake = ast.Expr(value=expr)
+        fake.lineno = getattr(expr, "lineno", 1)
+        return self._stmt_events(fake)
+
+
+def run(proj: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in proj.iter_trees():
+        in_scope = src.relpath.startswith(SCOPE_PREFIXES)
+        # key-reuse applies everywhere jax.random is used; clock/RNG
+        # rules only inside the scoped paths.
+        v = _DetVisitor(src.relpath, in_scope)
+        if in_scope:
+            v.visit(src.tree)
+        else:
+            # still scan for key reuse outside the scoped paths
+            v.in_scope = True
+            only_keys = _DetVisitor(src.relpath, True)
+            for qual, fn in _iter_funcs(src.tree):
+                only_keys._func_stack = [qual]
+                only_keys._scan_key_reuse(fn)
+            v = only_keys
+        findings.extend(v.findings)
+    return findings
+
+
+def _iter_funcs(tree: ast.AST):
+    stack: list[tuple[ast.AST, str]] = [(tree, "")]
+    while stack:
+        node, prefix = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                yield qual, child
+                stack.append((child, qual))
+            elif isinstance(child, ast.ClassDef):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                stack.append((child, qual))

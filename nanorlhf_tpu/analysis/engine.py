@@ -1,0 +1,227 @@
+"""nanolint engine: findings, allowlist annotations, baseline workflow.
+
+A *finding* is one violation of a project invariant at one site. Its
+identity (``key``) is ``rule::path::detail`` — deliberately free of
+line numbers so unrelated edits don't churn the baseline.
+
+Two suppression mechanisms, both requiring a written reason:
+
+- **allowlist annotation** — a comment on the finding's line (or the
+  line above): ``# nanolint: allow[<rule>] <reason>``. ``<rule>`` may
+  be the full rule id (``determinism.wall-clock``) or its family
+  prefix (``determinism``). An annotation with no reason is itself a
+  finding (``meta.allow-missing-reason``).
+
+- **baseline file** — JSON checked in at
+  ``nanorlhf_tpu/analysis/baseline.json`` listing known findings with
+  reasons. CI fails on findings not in the baseline ("fix or suppress
+  with a reason") AND on stale baseline entries that no longer fire
+  (so the baseline only ever shrinks or is consciously edited).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+ALLOW_RE = re.compile(
+    r"#\s*nanolint:\s*allow\[(?P<rule>[a-z0-9_.-]+)\]\s*(?P<reason>.*)$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str      # e.g. "determinism.wall-clock"
+    path: str      # repo-relative posix path
+    line: int      # 1-based, for humans; not part of identity
+    detail: str    # stable site identity, e.g. "time.time in FleetWorker._run#2"
+    message: str   # full human-readable explanation
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.detail}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    path: Path           # absolute
+    relpath: str         # repo-relative posix
+    text: str
+    lines: list[str]
+    tree: ast.AST | None
+    parse_error: str | None = None
+
+
+@dataclass
+class Project:
+    """Parsed view of the files under analysis plus repo-root context."""
+
+    root: Path
+    files: list[SourceFile] = field(default_factory=list)
+
+    def by_rel(self, relpath: str) -> SourceFile | None:
+        for f in self.files:
+            if f.relpath == relpath:
+                return f
+        return None
+
+    def iter_trees(self):
+        for f in self.files:
+            if f.tree is not None:
+                yield f
+
+
+def load_project(root: Path, targets: list[Path]) -> Project:
+    proj = Project(root=root)
+    seen: set[Path] = set()
+    for target in targets:
+        paths = sorted(target.rglob("*.py")) if target.is_dir() else [target]
+        for p in paths:
+            p = p.resolve()
+            if p in seen or p.suffix != ".py":
+                continue
+            seen.add(p)
+            text = p.read_text(encoding="utf-8")
+            rel = p.relative_to(root).as_posix() if p.is_relative_to(root) else p.as_posix()
+            try:
+                tree = ast.parse(text, filename=rel)
+                err = None
+            except SyntaxError as e:  # report, don't crash the lint run
+                tree, err = None, f"{e.msg} (line {e.lineno})"
+            proj.files.append(SourceFile(p, rel, text, text.splitlines(), tree))
+            proj.files[-1].parse_error = err
+    return proj
+
+
+def _annotation_at(src: SourceFile, line: int):
+    """The allow-annotation covering 1-based `line`, if any.
+
+    Checked on the finding's own line (trailing comment) and the line
+    directly above (a dedicated comment line).
+    """
+    for lno in (line, line - 1):
+        if 1 <= lno <= len(src.lines):
+            m = ALLOW_RE.search(src.lines[lno - 1])
+            if m:
+                return m.group("rule"), m.group("reason").strip(), lno
+    return None
+
+
+def apply_allowlist(proj: Project, findings: list[Finding]) -> list[Finding]:
+    """Drop findings covered by a matching annotation with a reason.
+
+    Annotations with an empty reason never suppress and instead add a
+    meta.allow-missing-reason finding at the annotation site.
+    """
+    out: list[Finding] = []
+    for f in findings:
+        src = proj.by_rel(f.path)
+        ann = _annotation_at(src, f.line) if src else None
+        if ann is not None:
+            rule, reason, lno = ann
+            matches = f.rule == rule or f.rule.split(".")[0] == rule
+            if matches and reason:
+                continue  # suppressed with a written reason
+            if matches and not reason:
+                out.append(Finding(
+                    rule="meta.allow-missing-reason", path=f.path, line=lno,
+                    detail=f"allow[{rule}]@{f.detail}",
+                    message=f"allow[{rule}] annotation has no reason; "
+                            f"every suppression must say why",
+                ))
+        out.append(f)
+    return out
+
+
+def load_baseline(path: Path) -> tuple[list[dict], list[str]]:
+    """Baseline entries + validation errors (missing/empty reasons)."""
+    if not path.exists():
+        return [], []
+    data = json.loads(path.read_text(encoding="utf-8"))
+    entries = data.get("entries", [])
+    errors = []
+    for e in entries:
+        if not str(e.get("reason", "")).strip():
+            errors.append(
+                f"baseline entry {e.get('rule')}::{e.get('path')}::"
+                f"{e.get('detail')} has no written reason"
+            )
+    return entries, errors
+
+
+def diff_baseline(findings: list[Finding], entries: list[dict]):
+    """(new_findings, stale_entries) vs the baseline."""
+    baselined = {f"{e['rule']}::{e['path']}::{e['detail']}" for e in entries}
+    current = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baselined]
+    stale = [e for e in entries
+             if f"{e['rule']}::{e['path']}::{e['detail']}" not in current]
+    return new, stale
+
+
+def write_baseline(path: Path, findings: list[Finding], reason: str) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "detail": f.detail,
+         "line": f.line, "reason": reason, "message": f.message}
+        for f in sorted(findings, key=lambda f: f.key)
+    ]
+    path.write_text(
+        json.dumps({"entries": entries}, indent=2) + "\n", encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by the rule modules
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FuncIndex(ast.NodeVisitor):
+    """Maps every function/method node to a qualname like Class.method."""
+
+    def __init__(self):
+        self.funcs: dict[str, ast.AST] = {}   # qualname -> def node
+        self._stack: list[str] = []
+
+    def _visit_def(self, node):
+        self._stack.append(node.name)
+        self.funcs[".".join(self._stack)] = node
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+def index_functions(tree: ast.AST) -> dict[str, ast.AST]:
+    idx = FuncIndex()
+    idx.visit(tree)
+    return idx.funcs
+
+
+def parse_errors(proj: Project) -> list[Finding]:
+    return [
+        Finding(rule="meta.parse-error", path=f.relpath, line=1,
+                detail="parse", message=f"file does not parse: {f.parse_error}")
+        for f in proj.files if f.parse_error
+    ]

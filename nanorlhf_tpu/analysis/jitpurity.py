@@ -1,0 +1,272 @@
+"""Rule family 2 — jit purity / recompile hazards.
+
+Finds host syncs and trace-breaking Python control flow inside
+functions reachable from ``jax.jit`` / ``pjit`` call sites:
+
+  jit.host-sync      ``.item()``, ``float(...)`` / ``int(...)`` of a
+                     traced parameter, ``np.asarray`` / ``np.array`` of
+                     a traced parameter inside jit-reachable code. Each
+                     forces a device→host transfer (or a trace-time
+                     concretization error) in the hot path.
+  jit.traced-branch  Python ``if``/``while`` whose test references a
+                     traced (non-static) parameter of the jitted
+                     function. Branching on traced values either fails
+                     at trace time or — on values that happen to be
+                     concrete — silently forks the compile cache.
+
+"Traced" is approximated conservatively: the parameters of the jitted
+entry function minus ``static_argnums`` / ``static_argnames``. The
+reachability closure follows same-module calls (module-level functions
+and ``self.``-methods of the same class); traced-ness does not
+propagate through calls — callees are only checked for unconditional
+hazards (``.item()``) to keep the false-positive rate near zero.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Project, dotted_name, index_functions
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit",
+              "jax.experimental.pjit.pjit"}
+
+
+def _jit_call_info(call: ast.Call):
+    """If `call` is jax.jit(...)/partial(jax.jit, ...), return
+    (wrapped_name_or_None, static_argnums, static_argnames)."""
+    name = dotted_name(call.func)
+    args = list(call.args)
+    if name in ("partial", "functools.partial") and args:
+        inner_name = dotted_name(args[0])
+        if inner_name in _JIT_NAMES:
+            return _extract(call, args[1:])
+        return None
+    if name in _JIT_NAMES:
+        return _extract(call, args)
+    return None
+
+
+def _extract(call: ast.Call, fn_args: list[ast.expr]):
+    wrapped = None
+    if fn_args:
+        a = fn_args[0]
+        if isinstance(a, ast.Name):
+            wrapped = a.id
+        elif isinstance(a, ast.Attribute):
+            wrapped = dotted_name(a)
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames", "donate_argnums"):
+            vals = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = list(kw.value.elts)
+            elif isinstance(kw.value, ast.Constant):
+                vals = [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant):
+                    if kw.arg == "static_argnums" and isinstance(v.value, int):
+                        nums.append(v.value)
+                    elif kw.arg == "static_argnames" and isinstance(v.value, str):
+                        names.append(v.value)
+    return wrapped, nums, names
+
+
+class _JitSites(ast.NodeVisitor):
+    """Collects (function qualname, static nums/names) for every jitted fn."""
+
+    def __init__(self):
+        self.sites: dict[str, tuple[list[int], list[str]]] = {}
+        self._class_stack: list[str] = []
+
+    def visit_ClassDef(self, node):
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _qual(self, name: str) -> str:
+        return ".".join(self._class_stack + [name])
+
+    def _visit_def(self, node):
+        for dec in node.decorator_list:
+            info = None
+            if isinstance(dec, ast.Call):
+                info = _jit_call_info(dec)
+            elif dotted_name(dec) in _JIT_NAMES:
+                info = (None, [], [])
+            if info is not None:
+                self._class_stack.append(node.name)
+                self._class_stack.pop()
+                self.sites[self._qual(node.name)] = (info[1], info[2])
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_Call(self, node):
+        info = _jit_call_info(node)
+        if info is not None and info[0]:
+            # jax.jit(fn, ...) call form: fn may be bare or dotted; keep
+            # the last component to match module-level defs and methods.
+            self.sites.setdefault(info[0].split(".")[-1], (info[1], info[2]))
+        self.generic_visit(node)
+
+
+def _params(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return names
+
+
+def _traced_params(fn: ast.AST, nums: list[int], statics: list[str]) -> set[str]:
+    names = _params(fn)
+    if names and names[0] in ("self", "cls"):
+        offset_names = names[1:]
+    else:
+        offset_names = names
+    static = set(statics)
+    for i in nums:
+        if 0 <= i < len(offset_names):
+            static.add(offset_names[i])
+    return {n for n in offset_names if n not in static}
+
+
+def _refs(expr: ast.AST, traced: set[str]) -> bool:
+    return any(isinstance(n, ast.Name) and n.id in traced
+               for n in ast.walk(expr))
+
+
+class _BodyChecker(ast.NodeVisitor):
+    def __init__(self, relpath: str, qual: str, traced: set[str],
+                 entry: bool):
+        self.relpath = relpath
+        self.qual = qual
+        self.traced = traced
+        self.entry = entry  # direct jit target (vs transitively reachable)
+        self.findings: list[Finding] = []
+        self._ord = 0
+
+    def _finding(self, rule: str, node: ast.AST, what: str, msg: str):
+        self._ord += 1
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath, line=node.lineno,
+            detail=f"{what} in {self.qual}#{self._ord}", message=msg))
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted_name(node.func)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args:
+            self._finding(
+                "jit.host-sync", node, "item",
+                ".item() inside jit-reachable code forces a device->host "
+                "sync (or a tracer concretization error); compute on-device "
+                "or hoist to the caller")
+        elif name in ("float", "int", "bool") and node.args and \
+                _refs(node.args[0], self.traced):
+            self._finding(
+                "jit.host-sync", node, f"{name}()",
+                f"{name}() applied to traced value inside a jitted "
+                f"function concretizes the tracer; keep it as a jax array")
+        elif name in ("np.asarray", "np.array", "numpy.asarray",
+                      "numpy.array") and node.args and \
+                _refs(node.args[0], self.traced):
+            self._finding(
+                "jit.host-sync", node, name,
+                f"{name}() of a traced value forces host materialization "
+                f"inside jit; use jnp instead")
+        self.generic_visit(node)
+
+    def _check_test(self, node, kind: str):
+        if self.traced and _refs(node.test, self.traced):
+            self._finding(
+                "jit.traced-branch", node, kind,
+                f"Python {kind} on a traced parameter inside a jitted "
+                f"function; use lax.cond/select or mark the argument "
+                f"static_argnames")
+
+    def visit_If(self, node):
+        self._check_test(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node, "while")
+        self.generic_visit(node)
+
+
+def _callees(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if not name:
+                continue
+            if name.startswith("self."):
+                out.add(name.split(".", 1)[1])
+            elif "." not in name:
+                out.add(name)
+    return out
+
+
+def run(proj: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in proj.iter_trees():
+        if not src.relpath.startswith("nanorlhf_tpu/"):
+            continue
+        sites = _JitSites()
+        sites.visit(src.tree)
+        if not sites.sites:
+            continue
+        funcs = index_functions(src.tree)
+        # resolve jit sites to def nodes (qualname or last-component match)
+        resolved: dict[str, tuple[ast.AST, list[int], list[str]]] = {}
+        for qual, (nums, statics) in sites.sites.items():
+            node = funcs.get(qual)
+            if node is None:
+                cands = [q for q in funcs if q.split(".")[-1] == qual]
+                node = funcs[cands[0]] if len(cands) == 1 else None
+            if node is not None:
+                resolved[qual] = (node, nums, statics)
+
+        # reachability closure over same-module simple calls
+        reachable: dict[str, bool] = {}   # qualname -> is_entry
+        work = list(resolved.keys())
+        seen = set(work)
+        while work:
+            qual = work.pop()
+            node = (resolved[qual][0] if qual in resolved
+                    else funcs.get(qual))
+            if node is None:
+                for q2 in funcs:
+                    if q2.split(".")[-1] == qual:
+                        node = funcs[q2]
+                        break
+            if node is None:
+                continue
+            reachable[qual] = qual in resolved
+            for callee in _callees(node):
+                # match by last component within this module
+                for q2 in funcs:
+                    if q2.split(".")[-1] == callee and q2 not in seen:
+                        seen.add(q2)
+                        work.append(q2)
+
+        for qual, is_entry in reachable.items():
+            if qual in resolved:
+                node, nums, statics = resolved[qual]
+                traced = _traced_params(node, nums, statics)
+            else:
+                node = funcs.get(qual)
+                if node is None:
+                    cands = [q for q in funcs if q.split(".")[-1] == qual]
+                    node = funcs[cands[0]] if cands else None
+                traced = set()   # traced-ness doesn't propagate to callees
+            if node is None:
+                continue
+            checker = _BodyChecker(src.relpath, qual, traced, is_entry)
+            for stmt in node.body:
+                checker.visit(stmt)
+            findings.extend(checker.findings)
+    return findings

@@ -1,0 +1,363 @@
+"""Rule family 4 — static lock-acquisition graph.
+
+Extracts, from the AST alone, the "may acquire B while holding A" graph
+across the threaded modules (orchestrator/, telemetry/,
+trainer/metrics.py, resilience/faults.py) and checks it against the
+declared partial order in `lockorder.LOCK_ORDER`:
+
+  lockorder.undeclared  a raw threading.Lock/RLock/Condition() in a
+                        scoped module — every lock must be created via
+                        the named make_lock/make_rlock/make_condition
+                        factories so it has a declared rank (and so the
+                        runtime sanitizer can see it)
+  lockorder.inversion   an extracted edge A->B where rank(A) >= rank(B)
+  lockorder.cycle       a cycle in the extracted graph — a potential
+                        deadlock even if each edge looks locally benign
+
+Extraction model: each (class, method) gets a summary of (a) locks
+acquired directly (``with self._lock:`` blocks and ``.acquire()``
+calls on declared lock attributes) and (b) calls made while holding
+locks. Receivers are resolved through RECEIVER_TYPES — a
+project-specific attr->class table (this is a project lint, not a type
+checker) — plus same-module function names. A fixpoint pass closes
+"may acquire" over the call graph, then every (held, acquired) pair
+becomes an edge. Conservative in both directions by design: dynamic
+dispatch it can't see is missed (the runtime sanitizer covers that),
+and calls it can't prove lock-free are assumed lock-free.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .engine import Finding, Project, dotted_name
+from .lockorder import LOCK_ORDER, _RANK
+
+SCOPE = (
+    "nanorlhf_tpu/orchestrator/",
+    "nanorlhf_tpu/telemetry/",
+    "nanorlhf_tpu/trainer/metrics.py",
+    "nanorlhf_tpu/resilience/faults.py",
+)
+
+# attr-name -> class-name receiver table for resolving self._attr.m() calls.
+RECEIVER_TYPES: dict[str, str] = {
+    "_queue": "BoundedStalenessQueue",
+    "_lineage": "LineageLedger",
+    "lineage": "LineageLedger",
+    "_tracer": "SpanTracer",
+    "tracer": "SpanTracer",
+    "_meter": "OverlapMeter",
+    "meter": "OverlapMeter",
+    "_faults": "FaultInjector",
+    "faults": "FaultInjector",
+    "_store": "VersionedWeightStore",
+    "_coord": "FleetCoordinator",
+    "_health": "HealthMonitor",
+    "_logger": "MetricsLogger",
+    "_metrics": "MetricsLogger",
+    "_client": "RpcClient",
+    "_server": "FleetRpcServer",
+}
+
+# attrs that hold a bound method of another class (callable attributes).
+BOUND_METHODS: dict[str, tuple[str, str]] = {
+    "_transport_info": ("FleetRpcServer", "transport_info"),
+}
+
+_FACTORIES = {"make_lock": False, "make_rlock": True, "make_condition": False}
+_RAW = {"threading.Lock", "threading.RLock", "threading.Condition",
+        "Lock", "RLock", "Condition"}
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    via: str  # "direct" or the callee that transitively acquires dst
+
+
+@dataclass
+class LockGraph:
+    locks: dict[tuple[str, str], str] = field(default_factory=dict)
+    # (owner, attr) -> lock name; owner is a class name or "<module>:relpath"
+    reentrant: set[str] = field(default_factory=set)
+    edges: list[Edge] = field(default_factory=list)
+    undeclared: list[Finding] = field(default_factory=list)
+
+    def edge_pairs(self) -> set[tuple[str, str]]:
+        return {(e.src, e.dst) for e in self.edges}
+
+
+@dataclass
+class _MethodSummary:
+    qual: str                 # Class.method or module fn name
+    path: str = ""
+    direct: list[tuple[str, int, list[str]]] = field(default_factory=list)
+    # (lockname, line, held-at-acquire)
+    calls: list[tuple[str, int, list[str]]] = field(default_factory=list)
+    # (callee qual, line, held-at-call)
+
+
+class _Collector(ast.NodeVisitor):
+    """Builds per-method summaries + lock declarations for one file."""
+
+    def __init__(self, relpath: str, graph: LockGraph,
+                 summaries: dict[str, _MethodSummary]):
+        self.relpath = relpath
+        self.graph = graph
+        self.summaries = summaries
+        self._class: list[str] = []
+        self._method: list[_MethodSummary | None] = [None]
+        self._held: list[str] = []
+
+    # -- lock declarations ----------------------------------------------
+    def _lock_from_value(self, value: ast.expr) -> tuple[str | None, bool, bool]:
+        """(lockname, is_reentrant, is_raw_threading_primitive)."""
+        if not isinstance(value, ast.Call):
+            return None, False, False
+        name = dotted_name(value.func)
+        if name in _FACTORIES or (name and name.split(".")[-1] in _FACTORIES):
+            fn = (name if name in _FACTORIES else name.split(".")[-1])
+            if value.args and isinstance(value.args[0], ast.Constant):
+                return value.args[0].value, _FACTORIES[fn], False
+            return None, False, False
+        if name in _RAW:
+            return None, name.endswith("RLock"), True
+        return None, False, False
+
+    def visit_Assign(self, node: ast.Assign):
+        lockname, reentrant, raw = self._lock_from_value(node.value)
+        for t in node.targets:
+            owner = attr = None
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and self._class:
+                owner, attr = self._class[-1], t.attr
+            elif isinstance(t, ast.Name) and not self._class \
+                    and self._method[-1] is None:
+                owner, attr = f"<module>:{self.relpath}", t.id
+            if owner is None:
+                continue
+            if raw:
+                self.graph.undeclared.append(Finding(
+                    rule="lockorder.undeclared", path=self.relpath,
+                    line=node.lineno, detail=f"{owner}.{attr}",
+                    message=f"raw threading primitive at {owner}.{attr}; "
+                            f"create it via analysis.lockorder.make_lock/"
+                            f"make_rlock/make_condition with a name ranked "
+                            f"in LOCK_ORDER"))
+            elif lockname is not None:
+                self.graph.locks[(owner, attr)] = lockname
+                if reentrant:
+                    self.graph.reentrant.add(lockname)
+        self.generic_visit(node)
+
+    # -- structure -------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_def(self, node):
+        if self._class and self._method[-1] is None:
+            qual = f"{self._class[-1]}.{node.name}"
+        elif not self._class and self._method[-1] is None:
+            qual = node.name
+        else:
+            qual = None  # nested defs fold into the enclosing summary
+        if qual is not None:
+            summary = _MethodSummary(qual=qual, path=self.relpath)
+            self.summaries[qual] = summary
+            self._method.append(summary)
+            saved_held, self._held = self._held, []
+            self.generic_visit(node)
+            self._held = saved_held
+            self._method.pop()
+        else:
+            self.generic_visit(node)
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    # -- lock use --------------------------------------------------------
+    def _resolve_lock_expr(self, expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and self._class:
+            return self.graph.locks.get((self._class[-1], expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.graph.locks.get((f"<module>:{self.relpath}", expr.id))
+        return None
+
+    def visit_With(self, node: ast.With):
+        acquired: list[str] = []
+        summary = self._method[-1]
+        for item in node.items:
+            lock = self._resolve_lock_expr(item.context_expr)
+            if lock is not None:
+                if summary is not None:
+                    summary.direct.append(
+                        (lock, node.lineno, list(self._held)))
+                self._held.append(lock)
+                acquired.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in acquired:
+            self._held.remove(lock)
+        # also visit the context expressions themselves (call args etc.)
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def visit_Call(self, node: ast.Call):
+        summary = self._method[-1]
+        if summary is not None:
+            if isinstance(node.func, ast.Attribute):
+                recv, meth = node.func.value, node.func.attr
+                if meth in ("acquire", "wait", "wait_for") and \
+                        self._resolve_lock_expr(recv):
+                    lock = self._resolve_lock_expr(recv)
+                    if meth == "acquire":
+                        summary.direct.append(
+                            (lock, node.lineno, list(self._held)))
+                elif isinstance(recv, ast.Name) and recv.id == "self" \
+                        and self._class:
+                    if meth in BOUND_METHODS and not node.args:
+                        pass  # handled below as attr access
+                    summary.calls.append((f"{self._class[-1]}.{meth}",
+                                          node.lineno, list(self._held)))
+                elif isinstance(recv, ast.Attribute) and \
+                        isinstance(recv.value, ast.Name) and \
+                        recv.value.id == "self":
+                    cls = RECEIVER_TYPES.get(recv.attr)
+                    if cls is not None:
+                        summary.calls.append((f"{cls}.{meth}", node.lineno,
+                                              list(self._held)))
+            elif isinstance(node.func, ast.Name):
+                summary.calls.append((node.func.id, node.lineno,
+                                      list(self._held)))
+        # bound-method attributes called directly: self._transport_info()
+        if summary is not None and isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self" and \
+                node.func.attr in BOUND_METHODS:
+            cls, meth = BOUND_METHODS[node.func.attr]
+            summary.calls.append((f"{cls}.{meth}", node.lineno,
+                                  list(self._held)))
+        self.generic_visit(node)
+
+
+def extract(proj: Project) -> LockGraph:
+    graph = LockGraph()
+    summaries: dict[str, _MethodSummary] = {}
+    for src in proj.iter_trees():
+        if not src.relpath.startswith(SCOPE):
+            continue
+        _Collector(src.relpath, graph, summaries).visit(src.tree)
+
+    # fixpoint: ACQ[qual] = locks possibly acquired inside qual
+    acq: dict[str, set[str]] = {
+        q: {lock for lock, _, _ in s.direct} for q, s in summaries.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, s in summaries.items():
+            for callee, _, _ in s.calls:
+                extra = acq.get(callee)
+                if extra and not extra <= acq[q]:
+                    acq[q] |= extra
+                    changed = True
+
+    # edges
+    seen: set[tuple[str, str]] = set()
+    for q, s in summaries.items():
+        for lock, line, held in s.direct:
+            for h in held:
+                if (h, lock) not in seen:
+                    seen.add((h, lock))
+                    graph.edges.append(Edge(h, lock, s.path, line, "direct"))
+        for callee, line, held in s.calls:
+            if not held:
+                continue
+            for a in acq.get(callee, ()):
+                for h in held:
+                    if (h, a) not in seen:
+                        seen.add((h, a))
+                        graph.edges.append(Edge(h, a, s.path, line, callee))
+    return graph
+
+
+def _find_cycle(pairs: set[tuple[str, str]]) -> list[str] | None:
+    adj: dict[str, list[str]] = {}
+    for a, b in pairs:
+        adj.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    stack_path: list[str] = []
+
+    def dfs(n: str) -> list[str] | None:
+        color[n] = GREY
+        stack_path.append(n)
+        for m in adj.get(n, ()):
+            if color.get(m, WHITE) == GREY:
+                return stack_path[stack_path.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        stack_path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(adj):
+        if color.get(n, WHITE) == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return None
+
+
+def check(graph: LockGraph) -> list[Finding]:
+    findings: list[Finding] = list(graph.undeclared)
+    for e in graph.edges:
+        if e.src == e.dst and e.src in graph.reentrant:
+            continue  # reentrant re-acquire is the point of an RLock
+        ra, rb = _RANK.get(e.src), _RANK.get(e.dst)
+        if ra is None or rb is None:
+            continue  # undeclared lock already reported above
+        if ra >= rb:
+            findings.append(Finding(
+                rule="lockorder.inversion", path=e.path, line=e.line,
+                detail=f"{e.src}->{e.dst}",
+                message=f"acquires {e.dst!r} (rank {rb}) while holding "
+                        f"{e.src!r} (rank {ra}) via {e.via}; LOCK_ORDER "
+                        f"requires strictly ascending ranks"))
+    pairs = {(e.src, e.dst) for e in graph.edges
+             if not (e.src == e.dst and e.src in graph.reentrant)}
+    cyc = _find_cycle(pairs)
+    if cyc:
+        findings.append(Finding(
+            rule="lockorder.cycle", path="nanorlhf_tpu/analysis/lockorder.py",
+            line=1, detail="cycle:" + ">".join(cyc),
+            message=f"extracted lock graph has a cycle (potential "
+                    f"deadlock): {' -> '.join(cyc)}"))
+    return findings
+
+
+def run(proj: Project) -> list[Finding]:
+    return check(extract(proj))
+
+
+def render(graph: LockGraph) -> str:
+    lines = ["declared order (ascending):"]
+    for i, name in enumerate(LOCK_ORDER):
+        lines.append(f"  {i:2d}  {name}")
+    lines.append("extracted edges (held -> acquired):")
+    for e in sorted(graph.edges, key=lambda e: (e.src, e.dst)):
+        lines.append(f"  {e.src} -> {e.dst}   [{e.path}:{e.line} via {e.via}]")
+    if not graph.edges:
+        lines.append("  (none)")
+    return "\n".join(lines)

@@ -67,6 +67,8 @@ import collections
 import dataclasses
 import random
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_condition
 import time
 from typing import Callable, Optional
 
@@ -201,7 +203,7 @@ class FleetCoordinator:
         # telemetry.LineageLedger: lease-grant provenance (lease/worker ids,
         # reassigned_from on a re-grant) + late-duplicate drop attribution
         self._lineage = lineage
-        self._cond = threading.Condition()
+        self._cond = make_condition("fleet.coordinator")
         self._workers: dict[int, _WorkerRecord] = {}
         self._waiters: list[int] = []
         self._leases: dict[int, Lease] = {}
@@ -925,12 +927,19 @@ class RolloutWorker:
                             lease=lease.lease_id)
                     if tr is not None and tr.enabled else _null_ctx()
                 )
-                t0 = time.time()
+                # monotonic: [t0, t1] feeds the straggler-deadline latency
+                # EWMA (via QueuedSample dispatch/ready stamps) and the
+                # overlap meter — an NTP step across a wall-clock window
+                # would corrupt both. Same clock as the consumer's busy
+                # windows and the queue's transit stamps. (Cross-host
+                # transports must measure latency on ONE host's clock —
+                # these stamps are taken coordinator-side, so that holds.)
+                t0 = time.perf_counter()
                 with span:
                     payload = self._transport.dispatch(
                         self.worker_id, index, lease.batches[offset], tree
                     )
-                t1 = time.time()
+                t1 = time.perf_counter()
                 if self._meter is not None:
                     self._meter.note_gen(t0, t1, track=self.worker_id)
                 if self._lineage is not None and self._lineage.enabled:

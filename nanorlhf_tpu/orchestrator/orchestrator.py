@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 import time
 from typing import Callable, Optional
 
@@ -99,7 +101,7 @@ class OverlapMeter:
     _COMPACT_AT = 4096
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("orchestrator.meter")
         self._gen: list[tuple[float, float]] = []
         self._busy: list[tuple[float, float]] = []
         self._gen_ends: dict[int, float] = {}    # track -> latest end time
@@ -191,7 +193,7 @@ def note_ready_async(meter: OverlapMeter, payload, t0: float,
             jax.block_until_ready(payload)
         except Exception:
             return  # the consumer surfaces dispatch errors; meter stays silent
-        meter.note_gen(t0, time.time())
+        meter.note_gen(t0, time.perf_counter())
         if tp0 is not None:
             args = span_args or {}
             tracer.add_async(
@@ -284,14 +286,18 @@ class RolloutOrchestrator:
                     if tr is not None and tr.enabled
                     else contextlib.nullcontext()
                 )
-                t0 = time.time()
+                # monotonic: gen windows must share the consumer's busy-
+                # window clock (perf_counter) or the overlap meter's
+                # interval intersection silently goes to zero; wall clock
+                # would also expose gen_s to NTP steps
+                t0 = time.perf_counter()
                 with span:
                     payload = self._dispatch_fn(idx, tree)
                     # block HERE (producer thread): the consumer receives
                     # device-ready samples, and [t0, t1] is the true
                     # generation busy window for the overlap meter
                     jax.block_until_ready(payload)
-                t1 = time.time()
+                t1 = time.perf_counter()
                 self.meter.note_gen(t0, t1)
                 if lin is not None and lin.enabled:
                     lin.generation(

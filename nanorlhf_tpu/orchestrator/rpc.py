@@ -66,6 +66,8 @@ import dataclasses
 import socket
 import struct
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock, make_rlock
 import time
 import zlib
 from typing import Callable, Optional
@@ -435,7 +437,7 @@ class FleetRpcServer:
         self._coord = coordinator
         self._store = store
         self._faults = faults
-        self._lock = threading.Lock()
+        self._lock = make_lock("rpc.server")
         self._closed = threading.Event()
         self._peers: dict[int, dict] = {}  # worker_id -> transport record
         self._bytes_tx = 0
@@ -734,7 +736,7 @@ class RpcClient:
         self.worker_id = int(worker_id)
         self.cfg = config or RpcConfig()
         self._faults = faults
-        self._lock = threading.RLock()
+        self._lock = make_rlock("rpc.client")
         self._sock: Optional[socket.socket] = None
         self._seq = 0
         self._partitioned_until = 0.0

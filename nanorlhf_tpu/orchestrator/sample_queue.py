@@ -30,6 +30,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_condition
 import time
 from typing import Any, Optional
 
@@ -66,7 +68,7 @@ class BoundedStalenessQueue:
         self.maxsize = max_staleness + 1
         self._base = start_index     # gate arithmetic is RELATIVE to this
         self._q: collections.deque[QueuedSample] = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = make_condition("orchestrator.queue")
         self._version = 0            # latest published policy version
         self._error: Optional[BaseException] = None
         # ---- metrics (cumulative; resume seeds them from the journal) ----
@@ -175,11 +177,16 @@ class BoundedStalenessQueue:
                     if (self._lineage is not None
                             and self._lineage.enabled):
                         # dispatch/ready stamps share the producer's clock
-                        # (time.time), so queue wait = dequeue_t - enqueue_t
+                        # (perf_counter), so queue wait = dequeue_t -
+                        # enqueue_t is NTP-step-safe; the wall-clock
+                        # dequeue stamp survives as provenance only (the
+                        # ledger's own record envelope carries it too)
                         self._lineage.queue(
                             s.index, enqueue_t=s.ready_time,
-                            dequeue_t=time.time(),
+                            dequeue_t=time.perf_counter(),
                             staleness=staleness, policy_version=s.version,
+                            # nanolint: allow[determinism.wall-clock] dequeue_wall is a provenance stamp, not a duration input
+                            dequeue_wall=time.time(),
                         )
                     self._cond.notify_all()
                     return s

@@ -22,6 +22,8 @@ the store is plain host-side bookkeeping (no jax import).
 from __future__ import annotations
 
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_condition
 from typing import Any, Optional
 
 
@@ -35,7 +37,7 @@ class VersionedWeightStore:
     """
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = make_condition("orchestrator.weights")
         self._version = -1
         self._tree: Any = None
 

@@ -91,6 +91,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 from typing import Optional
 
 import numpy as np
@@ -227,7 +229,7 @@ class FaultInjector:
     production code leaves the calls in unconditionally."""
 
     def __init__(self, schedules: Optional[list[FaultSchedule]] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.faults")
         self._by_point: dict[str, list[FaultSchedule]] = {}
         for s in schedules or []:
             self._by_point.setdefault(s.point, []).append(s)

@@ -37,6 +37,8 @@ import collections
 import dataclasses
 import math
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 import time
 from typing import Callable, Optional
 
@@ -371,7 +373,7 @@ class HealthMonitor:
         self._blackbox_fn = blackbox_fn
         self._on_crit = on_crit
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.health")
         self._aggs: dict[str, MetricAggregate] = {}
         self._rates: dict[str, WindowedRate] = {
             r.metric: WindowedRate(self.cfg.window_s)

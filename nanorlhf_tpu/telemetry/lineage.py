@@ -48,6 +48,8 @@ import glob
 import json
 import os
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 import time
 from collections import deque
 from typing import Iterator, Optional
@@ -107,7 +109,7 @@ class LineageLedger:
         # human-readable PRNG derivation stamped on lease events, e.g.
         # "fold_in(fold_in(seed_key, 0x5E11), rollout_index)"
         self.key_path = key_path
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.lineage")
         self._fh = None
         self._seq = 0            # rotation file sequence
         self._event_index = 0    # monotonic across rotation AND resume

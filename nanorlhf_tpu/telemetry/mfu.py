@@ -33,6 +33,8 @@ backend): jax is only imported inside `recompile_counter()` /
 from __future__ import annotations
 
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 from typing import Optional
 
 # peak dense bf16 FLOPs/s per chip by device kind (public figures;
@@ -101,7 +103,7 @@ class RecompileCounter:
     Thread-safe: compiles can happen on the producer thread too."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.mfu.counter")
         self.count = 0
         self.seconds = 0.0
 
@@ -113,7 +115,7 @@ class RecompileCounter:
 
 
 _COUNTER: Optional[RecompileCounter] = None
-_COUNTER_LOCK = threading.Lock()
+_COUNTER_LOCK = make_lock("telemetry.mfu.registry")
 
 
 def recompile_counter() -> RecompileCounter:

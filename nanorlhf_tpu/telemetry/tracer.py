@@ -47,6 +47,8 @@ import json
 import math
 import os
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 import time
 from typing import Optional
 
@@ -83,7 +85,7 @@ class SpanTracer:
         self.enabled = bool(enabled)
         self.dropped = 0          # events past max_events (telemetry/spans_dropped)
         self._max_events = int(max_events)
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.tracer")
         self._events: list[dict] = []
         self._ring: collections.deque = collections.deque(maxlen=int(ring_len))
         self._counters: dict[str, float] = {}

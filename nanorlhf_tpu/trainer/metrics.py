@@ -14,6 +14,8 @@ import atexit
 import json
 import os
 import threading
+
+from nanorlhf_tpu.analysis.lockorder import make_lock
 import time
 
 
@@ -39,7 +41,7 @@ class MetricsLogger:
         self.report_to = report_to
         self._fh = None
         self._tb = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("trainer.metrics")
         self._latest: dict = {}
         if report_to in ("jsonl", "tensorboard"):
             os.makedirs(output_dir, exist_ok=True)
@@ -62,6 +64,7 @@ class MetricsLogger:
     def _emit(self, prefix: str, x: int, extra: dict, metrics: dict):
         # t_mono: perf_counter, PhaseTimer's clock discipline — rate windows
         # built on these rows survive NTP steps (unlike "time")
+        # nanolint: allow[determinism.wall-clock] the "time" row IS the wall-clock provenance stamp (METRICS.md); t_mono is the duration clock
         record = {"step": x, **extra, "time": time.time(),
                   "t_mono": time.perf_counter()}
         record.update({k: float(v) for k, v in metrics.items()})

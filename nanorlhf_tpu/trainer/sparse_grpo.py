@@ -372,7 +372,7 @@ class SparseGRPOTrainer(RLTrainer):
         # in samples — one rollout here is batch_size*n completion rows
         self.lineage.rows_hint = cfg.batch_size * n
         for update in range(1, n_updates + 1):
-            t_start = time.time()
+            t_start = time.perf_counter()  # sec_per_episode is a duration
             step_t0 = time.perf_counter()
             # telemetry (docs/OBSERVABILITY.md): profile-window poll + the
             # per-update span, same contract as the dense loop
@@ -698,7 +698,7 @@ class SparseGRPOTrainer(RLTrainer):
                 **({"sampler_capture/ratio_drift_new": abs(
                     agg.get("ratio_mean", 1.0) - 1.0
                 )} if capture else {}),
-                "sec_per_episode": (time.time() - t_start) / cfg.batch_size,
+                "sec_per_episode": (time.perf_counter() - t_start) / cfg.batch_size,
                 # memory series (docs/METRICS.md): saved bytes sized from
                 # this update's WIDEST backward bucket (rows bounded by the
                 # backward token budget at the max bucket width; resp_len /

@@ -228,7 +228,7 @@ class RolloutStream:
             # serial/rollout_ahead runs have no coordinator: the dispatch
             # itself is the lease grant (worker 0, cursor == index)
             lin.lease(self._idx, worker_id=0, cursor=self._idx, length=1)
-        t0 = time.time()
+        t0 = time.perf_counter()  # overlap-meter gen window: consumer clock
         ro = self._body(queries, key)
         # hand the watcher a FROZEN view of the async outputs — blocking on
         # `ro` itself would race the "_index" insertion below
@@ -991,6 +991,7 @@ class RLTrainer:
         latest = self.logger.latest()
         orch = self._orchestrator  # local ref: trainer may close it
         out = {
+            # nanolint: allow[determinism.wall-clock] statusz provenance stamp for scrapers, never a duration input
             "unix_time": time.time(),
             "algo": self.cfg.algo.value,
             "step": self.state.get("global_step", 0),
@@ -1003,7 +1004,7 @@ class RLTrainer:
             # MFU number above is not trustworthy
             "mfu_trusted": bool(self._peak_flops_known),
             "peak_flops_per_chip": self._peak_flops,
-            "staleness_avg": latest.get("orchestrator/staleness_avg"),
+            "staleness_avg": latest.get("orchestrator/staleness"),
             "health": self.health.snapshot(),
             # drop-reason counts since start + the last-N sample ring
             # (telemetry/lineage.py) — the live companion to the ledger
@@ -1701,7 +1702,7 @@ class RLTrainer:
         sample_staleness, queue_depth = 0, 0
         target_step = self.state["global_step"] + n_updates
         while self.state["global_step"] < target_step:
-            t_start = time.time()
+            t_start = time.perf_counter()  # sec_per_episode is a duration
             step_t0 = time.perf_counter()
             # windowed XLA profiling: open/close the jax.profiler window
             # for the update about to run (cfg.profile_at_step or the
@@ -1725,7 +1726,9 @@ class RLTrainer:
                 greedy_responses = ro["greedy"]
                 if greedy_responses is not None:
                     greedy_responses.block_until_ready()
-            t_busy0 = time.time()  # overlap meter: consumer busy from here
+            # overlap meter: consumer busy from here (perf_counter — must
+            # share the producers' gen-window clock or intersections die)
+            t_busy0 = time.perf_counter()
             if not use_orch and self.lineage.enabled:
                 # serial / rollout_ahead path has no producer thread to emit
                 # this: generation provenance lands here, once the arrays
@@ -2012,7 +2015,7 @@ class RLTrainer:
                     orch.publish(self._policy_snapshot())
 
             # ---- METRICS (names + semantics per docs/METRICS.md) -----------
-            sec_per_episode = (time.time() - t_start) / cfg.batch_size
+            sec_per_episode = (time.perf_counter() - t_start) / cfg.batch_size
             # entropy proxy: summed response negative logprob (the reference's
             # `(-logprobs).sum(1).mean()`, `GRPO/grpo_trainer.py:710`, with
             # pad positions masked to 0 instead of contributing the INVALID
@@ -2245,7 +2248,7 @@ class RLTrainer:
                 saved_this_step = True
             # overlap meter: consumer busy window = everything since the
             # sample was fetched (reward, scoring, update, logging, save)
-            meter.note_busy(t_busy0, time.time())
+            meter.note_busy(t_busy0, time.perf_counter())
             if self.tracer.enabled:
                 # the completed update's span on the trainer thread's track,
                 # with the correlation args that make trace.json queryable

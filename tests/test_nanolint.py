@@ -1,0 +1,420 @@
+"""nanolint: per-rule-family fixture tests + lock-order runtime tests.
+
+Each deliberately-broken fixture must trip exactly its rule (and the
+known-good twin must stay clean); the lock-graph test plants a synthetic
+inversion and expects a cycle; the OrderedLock test proves the runtime
+sanitizer raises on an out-of-order acquisition. Everything here is
+jax-free — the analysis package is stdlib-only by design.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from nanorlhf_tpu.analysis import (determinism, engine, jitpurity, lockgraph,
+                                   lockorder, registry)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _proj(tmp_path: Path, files: dict[str, str]) -> engine.Project:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return engine.load_project(tmp_path, [tmp_path])
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------------------------
+# family 1: determinism
+# --------------------------------------------------------------------------
+
+def test_wall_clock_fires_in_scope_and_perf_counter_is_clean(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/orchestrator/bad.py": """
+            import time
+            def latency():
+                t0 = time.time()
+                return time.perf_counter() - t0
+        """,
+        "nanorlhf_tpu/orchestrator/good.py": """
+            import time
+            def latency():
+                t0 = time.perf_counter()
+                return time.perf_counter() - t0
+        """,
+    })
+    findings = determinism.run(proj)
+    assert _rules(findings) == ["determinism.wall-clock"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("bad.py")
+
+
+def test_wall_clock_out_of_scope_is_ignored(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/telemetry/stamps.py": """
+            import time
+            def stamp():
+                return time.time()
+        """,
+    })
+    assert determinism.run(proj) == []
+
+
+def test_allowlist_annotation_suppresses_with_reason_only(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/orchestrator/prov.py": """
+            import time
+            def stamp():
+                # nanolint: allow[determinism.wall-clock] provenance stamp
+                return time.time()
+            def bare():
+                # nanolint: allow[determinism.wall-clock]
+                return time.time()
+        """,
+    })
+    findings = engine.apply_allowlist(proj, determinism.run(proj))
+    rules = _rules(findings)
+    # the reasoned annotation suppressed; the bare one did not, and it
+    # additionally flags the missing reason
+    assert "determinism.wall-clock" in rules
+    assert "meta.allow-missing-reason" in rules
+    assert len([f for f in findings
+                if f.rule == "determinism.wall-clock"]) == 1
+
+
+def test_unseeded_random_fires_seeded_ctor_clean(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/trainer/rng.py": """
+            import random
+            import numpy as np
+            def bad():
+                return random.random() + np.random.rand()
+            def good(seed):
+                return random.Random(seed).random() \
+                    + np.random.default_rng(seed).random()
+        """,
+    })
+    findings = determinism.run(proj)
+    assert _rules(findings) == ["determinism.unseeded-random"]
+    assert len(findings) == 2
+    assert all("bad" in f.detail or f.line <= 5 for f in findings)
+
+
+def test_key_reuse_fires_and_split_or_branches_are_clean(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/sampler/keys.py": """
+            import jax
+            def bad(key):
+                a = jax.random.normal(key)
+                b = jax.random.uniform(key)
+                return a + b
+            def good(key):
+                a = jax.random.normal(key)
+                key, sub = jax.random.split(key)
+                b = jax.random.uniform(key)
+                return a + b
+            def branches(key, flag):
+                if flag:
+                    return jax.random.normal(key)
+                return jax.random.uniform(key)
+        """,
+    })
+    findings = determinism.run(proj)
+    assert _rules(findings) == ["determinism.key-reuse"]
+    assert len(findings) == 1
+    assert "bad" in findings[0].detail
+
+
+# --------------------------------------------------------------------------
+# family 2: jit purity
+# --------------------------------------------------------------------------
+
+def test_jit_host_sync_item_fires(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/trainer/jitted.py": """
+            import jax
+            @jax.jit
+            def step(x):
+                return x.sum().item()
+            def helper(x):
+                return x.item()  # reachable? no jit entry calls it
+        """,
+    })
+    findings = jitpurity.run(proj)
+    assert "jit.host-sync" in _rules(findings)
+    assert any(f.detail.startswith("item in step") for f in findings)
+
+
+def test_jit_traced_branch_fires_static_is_clean(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/trainer/branchy.py": """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("mode",))
+            def ok(x, mode):
+                if mode:
+                    return x + 1
+                return x
+            @jax.jit
+            def bad(x):
+                if x > 0:
+                    return x + 1
+                return x
+        """,
+    })
+    findings = jitpurity.run(proj)
+    assert _rules(findings) == ["jit.traced-branch"]
+    assert len(findings) == 1
+    assert "bad" in findings[0].detail
+
+
+def test_jit_reachability_through_same_module_call(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/trainer/reach.py": """
+            import jax
+            def inner(x):
+                return x.item()
+            @jax.jit
+            def outer(x):
+                return inner(x)
+        """,
+    })
+    findings = jitpurity.run(proj)
+    assert any(f.rule == "jit.host-sync" and "inner" in f.detail
+               for f in findings)
+
+
+def test_repo_jit_bodies_are_clean():
+    proj = engine.load_project(REPO, [REPO / "nanorlhf_tpu"])
+    assert jitpurity.run(proj) == []
+
+
+# --------------------------------------------------------------------------
+# family 3: registry cross-checks
+# --------------------------------------------------------------------------
+
+def test_fault_site_cross_check_both_directions(tmp_path):
+    proj = _proj(tmp_path, {
+        "docs/RESILIENCE.md": """
+            | point | wired where | effect |
+            |---|---|---|
+            | `ckpt.save` | somewhere | raises |
+            | `ghost.site` | documented only | never fired |
+        """,
+        "nanorlhf_tpu/resilience/f.py": """
+            def go(faults):
+                faults.fire("ckpt.save")
+                faults.fire("rogue.site")
+        """,
+    })
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    findings = registry.run(proj)
+    rules = _rules(findings)
+    assert "registry.fault-site-undocumented" in rules   # rogue.site
+    assert "registry.fault-site-unwired" in rules        # ghost.site
+    assert any("rogue.site" in f.detail for f in findings)
+    assert any("ghost.site" in f.detail for f in findings)
+
+
+def test_metric_doc_cross_check(tmp_path):
+    proj = _proj(tmp_path, {
+        "docs/METRICS.md": """
+            | Metric | Reference semantics | This framework |
+            |---|---|---|
+            | `perf/mfu` | — | documented and emitted |
+            | `perf/ghost` | — | documented, never emitted |
+            | `health/rule_<name>` | — | wildcard row |
+        """,
+        "docs/RESILIENCE.md": "",
+        "nanorlhf_tpu/trainer/em.py": """
+            def emit(rules):
+                out = {"perf/mfu": 1.0, "perf/rogue": 2.0}
+                for r in rules:
+                    out[f"health/rule_{r}"] = 0.0
+                return out
+        """,
+    })
+    findings = registry.run(proj)
+    assert any(f.rule == "registry.metric-undocumented"
+               and "perf/rogue" in f.detail for f in findings)
+    assert any(f.rule == "registry.metric-unemitted"
+               and "perf/ghost" in f.detail for f in findings)
+    # the wildcard row is matched by the f-string pattern: no unemitted
+    # finding for health/rule_*
+    assert not any("health/rule" in f.detail for f in findings)
+
+
+def test_health_rule_metric_must_be_emitted(tmp_path):
+    proj = _proj(tmp_path, {
+        "docs/METRICS.md": "| `perf/mfu` | — | x |\n",
+        "docs/RESILIENCE.md": "",
+        "nanorlhf_tpu/telemetry/h.py": """
+            def rules(HealthRule):
+                return [HealthRule(name="r", metric="perf/never_emitted")]
+        """,
+    })
+    findings = registry.run(proj)
+    assert any(f.rule == "registry.health-rule-metric" for f in findings)
+
+
+def test_repo_registry_is_green():
+    proj = engine.load_project(
+        REPO, [REPO / "nanorlhf_tpu", REPO / "tools"])
+    proj.files = [f for f in proj.files if not f.relpath.startswith("tests/")]
+    assert registry.run(proj) == []
+
+
+# --------------------------------------------------------------------------
+# family 4: lock order
+# --------------------------------------------------------------------------
+
+def test_lock_graph_synthetic_inversion_and_cycle(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/orchestrator/inv.py": """
+            from nanorlhf_tpu.analysis.lockorder import make_lock
+
+            class Inverted:
+                def __init__(self):
+                    self._coord = make_lock("fleet.coordinator")
+                    self._ledger = make_lock("telemetry.lineage")
+                def forward(self):
+                    with self._coord:
+                        with self._ledger:
+                            pass
+                def backward(self):
+                    with self._ledger:
+                        with self._coord:
+                            pass
+        """,
+    })
+    graph = lockgraph.extract(proj)
+    findings = lockgraph.check(graph)
+    rules = _rules(findings)
+    # backward holds lineage (high rank) then takes coordinator (rank 0):
+    # an inversion; together with forward's edge it is a cycle
+    assert "lockorder.inversion" in rules
+    assert "lockorder.cycle" in rules
+
+
+def test_lock_graph_undeclared_raw_lock(tmp_path):
+    proj = _proj(tmp_path, {
+        "nanorlhf_tpu/orchestrator/raw.py": """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        """,
+    })
+    findings = lockgraph.run(proj)
+    assert _rules(findings) == ["lockorder.undeclared"]
+
+
+def test_repo_lock_graph_is_cycle_free_and_ordered():
+    proj = engine.load_project(REPO, [REPO / "nanorlhf_tpu"])
+    graph = lockgraph.extract(proj)
+    findings = lockgraph.check(graph)
+    assert findings == [], [f.render() for f in findings]
+    # and the graph is non-trivial: the audited cross-subsystem edges exist
+    pairs = graph.edge_pairs()
+    assert ("fleet.coordinator", "orchestrator.queue") in pairs
+    assert ("orchestrator.queue", "telemetry.lineage") in pairs
+    assert ("fleet.coordinator", "rpc.server") in pairs
+
+
+# --------------------------------------------------------------------------
+# OrderedLock runtime sanitizer
+# --------------------------------------------------------------------------
+
+def test_ordered_lock_violation_raises(monkeypatch):
+    monkeypatch.setenv("NANORLHF_LOCK_CHECK", "1")
+    lo = lockorder.make_lock("fleet.coordinator")
+    hi = lockorder.make_lock("telemetry.lineage")
+    with lo:
+        with hi:
+            pass  # ascending: fine
+    with pytest.raises(lockorder.LockOrderViolation):
+        with hi:
+            with lo:
+                pass
+
+
+def test_ordered_lock_unknown_name_rejected(monkeypatch):
+    monkeypatch.setenv("NANORLHF_LOCK_CHECK", "1")
+    with pytest.raises(lockorder.LockOrderViolation):
+        lockorder.make_lock("not.in.the.order")
+
+
+def test_ordered_condition_wait_notify(monkeypatch):
+    monkeypatch.setenv("NANORLHF_LOCK_CHECK", "1")
+    cond = lockorder.make_condition("orchestrator.queue")
+    state = []
+
+    def waiter():
+        with cond:
+            cond.wait_for(lambda: state, timeout=5)
+            state.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.05)
+    with cond:
+        state.append(1)
+        cond.notify_all()
+    t.join(timeout=5)
+    assert "woke" in state
+    # the wait released the lock: the held stack is empty afterwards
+    assert lockorder.held_locks() == []
+
+
+def test_ordered_rlock_reentrant(monkeypatch):
+    monkeypatch.setenv("NANORLHF_LOCK_CHECK", "1")
+    r = lockorder.make_rlock("rpc.client")
+    with r:
+        with r:
+            assert lockorder.held_locks() == ["rpc.client"]
+    assert lockorder.held_locks() == []
+
+
+def test_factories_return_plain_primitives_when_disabled(monkeypatch):
+    monkeypatch.delenv("NANORLHF_LOCK_CHECK", raising=False)
+    assert not isinstance(lockorder.make_lock("fleet.coordinator"),
+                          lockorder.OrderedLock)
+    cond = lockorder.make_condition("orchestrator.queue")
+    assert isinstance(cond, threading.Condition)
+    assert not isinstance(cond._lock, lockorder.OrderedLock)
+
+
+# --------------------------------------------------------------------------
+# CLI + baseline workflow
+# --------------------------------------------------------------------------
+
+def test_cli_repo_is_clean():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "nanolint.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_baseline_requires_reason_and_flags_stale(tmp_path):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"entries": [
+        {"rule": "determinism.wall-clock", "path": "x.py",
+         "detail": "time.time in f", "reason": ""},
+    ]}))
+    entries, errors = engine.load_baseline(baseline)
+    assert errors, "empty reason must be rejected"
+    new, stale = engine.diff_baseline([], entries)
+    assert stale == entries, "entry with no matching finding is stale"

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""nanolint — project-invariant static analysis for nanorlhf_tpu.
+
+Usage:
+    python tools/nanolint.py [paths...] [--baseline FILE]
+                             [--write-baseline REASON] [--lock-graph]
+                             [--json] [--rules PREFIX[,PREFIX...]]
+
+Default paths: nanorlhf_tpu/ tools/. Exit status 0 iff every finding is
+either allowlisted in source (`# nanolint: allow[rule] reason`) or
+present in the baseline file with a written reason, AND no baseline
+entry is stale. See docs/STATIC_ANALYSIS.md for the rule catalog and
+the fix-or-suppress workflow.
+
+Runs jax-free: the engine imports only stdlib plus the telemetry
+exporter's Prometheus validator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from nanorlhf_tpu.analysis import (  # noqa: E402
+    determinism, engine, jitpurity, lockgraph, registry)
+
+DEFAULT_BASELINE = REPO / "nanorlhf_tpu" / "analysis" / "baseline.json"
+
+RULE_FAMILIES = {
+    "determinism": determinism.run,
+    "jit": jitpurity.run,
+    "registry": registry.run,
+    "lockorder": lockgraph.run,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: nanorlhf_tpu/ tools/)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline JSON (default: %(default)s)")
+    ap.add_argument("--write-baseline", metavar="REASON", default=None,
+                    help="write all current findings to the baseline file "
+                         "with REASON and exit 0")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the extracted lock graph and exit")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-family filter "
+                         "(determinism,jit,registry,lockorder)")
+    args = ap.parse_args(argv)
+
+    targets = ([Path(p).resolve() for p in args.paths] if args.paths
+               else [REPO / "nanorlhf_tpu", REPO / "tools"])
+    # never lint the test fixtures dir (it contains deliberately-bad code)
+    targets = [t for t in targets if t.exists()]
+    proj = engine.load_project(REPO, targets)
+    proj.files = [f for f in proj.files
+                  if "/fixtures/" not in f.relpath
+                  and not f.relpath.startswith("tests/")]
+
+    if args.lock_graph:
+        graph = lockgraph.extract(proj)
+        print(lockgraph.render(graph))
+        return 0
+
+    families = (args.rules.split(",") if args.rules
+                else list(RULE_FAMILIES))
+    findings: list[engine.Finding] = engine.parse_errors(proj)
+    for fam in families:
+        findings.extend(RULE_FAMILIES[fam](proj))
+    findings = engine.apply_allowlist(proj, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    baseline_path = Path(args.baseline)
+    if not baseline_path.is_absolute():
+        baseline_path = (REPO / baseline_path).resolve()
+        if not baseline_path.exists() and DEFAULT_BASELINE.exists():
+            # tolerate the documented shorthand `--baseline analysis/baseline.json`
+            alt = REPO / "nanorlhf_tpu" / args.baseline
+            baseline_path = alt if alt.exists() else baseline_path
+
+    if args.write_baseline is not None:
+        engine.write_baseline(baseline_path, findings, args.write_baseline)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    entries, reason_errors = engine.load_baseline(baseline_path)
+    new, stale = engine.diff_baseline(findings, entries)
+
+    if args.json:
+        print(json.dumps({
+            "findings": len(findings), "new": [f.__dict__ for f in new],
+            "stale": stale, "baseline_errors": reason_errors}, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"{baseline_path.name}: stale baseline entry "
+                  f"{e['rule']}::{e['path']}::{e['detail']} — the finding "
+                  f"no longer fires; delete the entry")
+        for err in reason_errors:
+            print(f"{baseline_path.name}: {err}")
+        n_ok = len(findings) - len(new)
+        print(f"nanolint: {len(findings)} finding(s), {n_ok} baselined/"
+              f"known, {len(new)} new, {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'}")
+
+    return 1 if (new or stale or reason_errors) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
