@@ -560,13 +560,13 @@ def _paged_check(jax) -> dict:
             stats.append(st[-1])
         return out, stats
 
-    def run_queued():
+    def run_queued(latency=None):
         pst: list = []
         out = np.asarray(generate(
             params, mcfg, ids, mask, jax.random.PRNGKey(0),
             SamplingParams(greedy=True, max_tokens=resp, spec_k=spec_k,
                            page_size=P, decode_rows=R),
-            paged_stats_out=pst, **kw))
+            paged_stats_out=pst, latency=latency, **kw))
         return out, pst[-1]
 
     walls = {}
@@ -575,6 +575,21 @@ def _paged_check(jax) -> dict:
             t0 = time.time()
             out, stats = fn()
             walls[name] = (out, stats, time.time() - t0)
+
+    # per-request TTFT + inter-token percentiles (telemetry/hist.py): one
+    # extra queued run with a hub attached — its admission-prefill syncs
+    # would perturb the timed A/B above, so it is deliberately untimed
+    from nanorlhf_tpu.telemetry.hist import LatencyHub
+
+    hub = LatencyHub()
+    run_queued(latency=hub)
+    lat_cols = {}
+    for col, key in (("ttft", "latency/ttft_s"),
+                     ("intertoken", "latency/intertoken_s")):
+        if hub.count(key):
+            lat_cols[f"{col}_p50_s"] = round(hub.quantile(key, 0.50), 5)
+            lat_cols[f"{col}_p95_s"] = round(hub.quantile(key, 0.95), 5)
+            lat_cols[f"{col}_count"] = hub.count(key)
 
     out_f, stats_f, sec_f = walls["fixed"]
     out_q, stats_q, sec_q = walls["queued"]
@@ -600,6 +615,7 @@ def _paged_check(jax) -> dict:
         "tokens_per_sec_queued": round(tokens / sec_q, 1),
         "sec_fixed": round(sec_f, 3),
         "sec_queued": round(sec_q, 3),
+        **lat_cols,
         "greedy_bit_identical": identical,
         "paged_check": "ok" if (
             identical and queued_dispatches < fixed_dispatches
@@ -757,7 +773,7 @@ def run_bench(jax, init_error):
     def measure(r_quant, kv_quant, ahead, resp=None, capture=False,
                 orchestrator=False, staleness=2, sentinel=True,
                 telemetry=False, spec_k=None, workers=1, health=True,
-                lineage=False, transport="inprocess"):
+                lineage=False, transport="inprocess", latency=True):
         """One full config measurement: fresh trainer, warmup update
         (compile) + n_updates timed. Returns the timing dict.
 
@@ -795,6 +811,7 @@ def run_bench(jax, init_error):
             telemetry=telemetry,
             health=health,
             lineage=lineage,
+            latency=latency,
             kv_cache_quant=kv_quant,
             rollout_spec_k=spec_k,
             gradient_checkpointing=True,
@@ -843,6 +860,10 @@ def run_bench(jax, init_error):
                 k: round((v - phase_snapshot.get(k, 0.0)) / max(len(steady), 1), 3)
                 for k, v in sorted(trainer.timer.cumulative.items())
             },
+            # latency surface (telemetry/hist.py): per-key count/mean/
+            # p50/p95/p99 from this run's streaming histograms — the
+            # fleet detail's TTFT/queue-wait percentile columns read it
+            "latency_summary": trainer.latency.snapshot(),
         }
 
     t_baseline = time.time()
@@ -1077,6 +1098,37 @@ def run_bench(jax, init_error):
         except Exception as e:
             lineage_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # latency-surface overhead A/B (docs/OBSERVABILITY.md §7 acceptance:
+    # the default-ON streaming histograms — TTFT/queue-wait/reward/phase
+    # recording plus SLO-rule quantile reads — cost < 1% of step wall):
+    # the chosen config already ran with the hub on, so re-measure with
+    # cfg.latency off and report on-vs-off. Same budget gate as the other
+    # observability A/Bs.
+    latency_detail = None
+    if (os.environ.get("BENCH_LATENCY", "1") == "1"
+            and budget - (time.time() - _T0) > 0.9 * t_baseline):
+        try:
+            latency_off = measure(
+                chosen["rollout_quant"], chosen["kv_cache_quant"],
+                chosen["rollout_ahead"],
+                capture=chosen["sampler_logprob_capture"],
+                orchestrator=chosen["rollout_orchestrator"],
+                staleness=chosen["max_staleness"] or orch_staleness,
+                spec_k=chosen.get("rollout_spec_k", 0),
+                latency=False,
+            )
+            off_sec = latency_off["sec_per_update_steady"]
+            latency_detail = {
+                "off_sec_per_update": off_sec,
+                "on_sec_per_update": chosen["sec_per_update_steady"],
+                "latency_overhead_frac": round(
+                    (chosen["sec_per_update_steady"] - off_sec)
+                    / max(off_sec, 1e-9), 4,
+                ),
+            }
+        except Exception as e:
+            latency_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+
     # fleet-coordinator overhead A/B (docs/FLEET.md acceptance: the lease /
     # reorder-buffer / liveness machinery costs < 2% of step wall): measure
     # the single-producer pipeline and the N-worker fleet at the SAME
@@ -1115,6 +1167,17 @@ def run_bench(jax, init_error):
                     (fleet_sec - single_sec) / max(single_sec, 1e-9), 4,
                 ),
             }
+            # TTFT / queue-wait percentile columns (telemetry/hist.py):
+            # the fleet run's own histograms — dispatch→device-ready TTFT
+            # upper bound per generation, dequeue−ready queue wait per
+            # consumed sample
+            for col, key in (("ttft", "latency/ttft_s"),
+                             ("queue_wait", "latency/queue_wait_s")):
+                summ = fleet.get("latency_summary", {}).get(key)
+                if summ and summ.get("count"):
+                    fleet_detail[f"{col}_p50_s"] = round(summ["p50_s"], 4)
+                    fleet_detail[f"{col}_p95_s"] = round(summ["p95_s"], 4)
+                    fleet_detail[f"{col}_count"] = summ["count"]
             # loopback-RPC transport A/B (docs/FLEET.md §multi-host
             # acceptance: framing + codec + retry machinery costs < 5% of
             # step wall at 2 workers): same fleet config, the 3-call seam
@@ -1276,6 +1339,8 @@ def run_bench(jax, init_error):
         detail["health"] = health_detail
     if lineage_detail is not None:
         detail["lineage"] = lineage_detail
+    if latency_detail is not None:
+        detail["latency"] = latency_detail
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
     if short_detail is not None:

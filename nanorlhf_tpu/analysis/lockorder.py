@@ -39,6 +39,9 @@ import threading
 #                         resilience.faults}
 #   orchestrator.queue -> telemetry.lineage
 #   rpc.client -> resilience.faults
+#   {orchestrator.queue, rpc.client, telemetry.health} -> telemetry.hist
+#     (queue-wait / RPC-RTT recording under the holder's lock; SLO rule
+#      evaluation reads hub quantiles under the health monitor's lock)
 LOCK_ORDER: tuple[str, ...] = (
     "fleet.coordinator",      # FleetCoordinator._cond      (fleet.py)
     "orchestrator.queue",     # BoundedStalenessQueue._cond (sample_queue.py)
@@ -47,6 +50,7 @@ LOCK_ORDER: tuple[str, ...] = (
     "rpc.client",             # RpcClient._lock             (rpc.py)
     "trainer.metrics",        # MetricsLogger._lock         (metrics.py)
     "telemetry.health",       # HealthMonitor._lock         (health.py)
+    "telemetry.hist",         # LatencyHub._lock            (hist.py)
     "telemetry.tracer",       # SpanTracer._lock            (tracer.py)
     "telemetry.lineage",      # LineageLedger._lock         (lineage.py)
     "orchestrator.meter",     # OverlapMeter._lock          (orchestrator.py)

@@ -29,6 +29,13 @@ hist_{k}"``) which are matched as patterns. Doc-side wildcards
 ``{a,b}`` brace lists) are expanded/normalized symmetrically. Bare keys
 without a slash (``lr``, ``episode``) are out of scope — indistinguishable
 from ordinary strings.
+
+Histogram families (keys under ``hist.HISTOGRAM_KEY_PREFIX``) get shape-
+aware treatment: the Prometheus surface derives three sample names per
+family (``_bucket{le="..."}``/``_sum``/``_count``), so both cross-check
+directions fold such suffixes back to the family before diffing, and the
+prometheus rule renders these keys through the real histogram exposition
+path instead of the gauge renderer.
 """
 
 from __future__ import annotations
@@ -54,6 +61,29 @@ _NOT_METRICS = {"text/plain", "text/html", "application/json",
 _KEY_RE = re.compile(r'^[a-z][a-z0-9_]*/[a-z0-9_]+(\{[a-z_]+="[^"]*"\})?$')
 _FSTR_SEG_RE = re.compile(r'^[a-z0-9_/{}="]*$')
 _FAULT_RE = re.compile(r"^[a-z_]+\.[a-z_]+$")
+
+# histogram metric families (telemetry/hist.py): a key under this prefix
+# is exported as Prometheus HISTOGRAM exposition — three derived sample
+# names per family (`<f>_bucket{le="..."}`, `<f>_sum`, `<f>_count`)
+# instead of one gauge line. METRICS.md documents the FAMILY name once;
+# the cross-check below normalizes both directions (a doc row carrying an
+# explicit suffix/label, or a code literal building one, folds back to
+# its family before the diff).
+try:
+    from nanorlhf_tpu.telemetry.hist import HISTOGRAM_KEY_PREFIX
+except Exception:  # pragma: no cover - hist.py is jax-free
+    HISTOGRAM_KEY_PREFIX = "latency/"
+
+_HIST_SUFFIX_RE = re.compile(r'(_bucket(\{le="[^"]*"\})?|_sum|_count)$')
+
+
+def hist_family(name: str) -> str:
+    """Fold a histogram sample name back to its family key: strip one
+    `_bucket{le="..."}`/`_bucket`/`_sum`/`_count` suffix from keys under
+    the histogram prefix; every other name passes through unchanged."""
+    if not name.startswith(HISTOGRAM_KEY_PREFIX):
+        return name
+    return _HIST_SUFFIX_RE.sub("", name)
 
 
 # ---------------------------------------------------------------------------
@@ -214,8 +244,13 @@ def run(proj: Project) -> list[Finding]:
     wild_prefixes = [w.split("*")[0] for w in doc_wild]
 
     def documented(key: str) -> bool:
-        return key in doc_exact or any(
-            key.startswith(p) and p for p in wild_prefixes)
+        if key in doc_exact or any(
+                key.startswith(p) and p for p in wild_prefixes):
+            return True
+        # histogram shape: a code literal naming an exposition sample
+        # (`latency/x_s_count`) is covered by its documented family row
+        fam = hist_family(key)
+        return fam != key and documented(fam)
 
     for key, (path, line) in sorted(keys.items()):
         if not documented(key):
@@ -245,9 +280,17 @@ def run(proj: Project) -> list[Finding]:
             pre = doc_name.split("*")[0]
             if any(k.startswith(pre) for k in keys):
                 return True
-            return any(rx.match(probe) for rx, _, _ in pattern_res)
-        return doc_name in keys or any(rx.match(doc_name)
-                                       for rx, _, _ in pattern_res)
+            if any(rx.match(probe) for rx, _, _ in pattern_res):
+                return True
+        elif doc_name in keys or any(rx.match(doc_name)
+                                     for rx, _, _ in pattern_res):
+            return True
+        # histogram shape, doc→code direction: a doc row spelling an
+        # exposition suffix (`latency/x_s_bucket{le="..."}` — the `...`
+        # label arrives here as `*`) is emitted when code references the
+        # family it derives from
+        fam = hist_family(probe)
+        return fam != probe and emitted(fam)
 
     for doc_name in sorted(doc_exact) + sorted(doc_wild):
         if not emitted(doc_name):
@@ -275,15 +318,26 @@ def run(proj: Project) -> list[Finding]:
 def _prometheus_check(keys: dict[str, tuple[str, int]]) -> list[Finding]:
     try:
         from nanorlhf_tpu.telemetry.exporter import (
-            render_prometheus, validate_prometheus_text)
+            render_prometheus, render_prometheus_histograms,
+            validate_prometheus_text)
+        from nanorlhf_tpu.telemetry.hist import StreamingHistogram
     except Exception as e:  # pragma: no cover - exporter is jax-free
         return [Finding(
             rule="registry.prometheus", path="nanorlhf_tpu/telemetry/exporter.py",
             line=1, detail="import",
             message=f"could not import the shared Prometheus validator: {e}")]
+    probe_hist = StreamingHistogram()
+    probe_hist.record(0.05)
     out: list[Finding] = []
     for key, (path, line) in sorted(keys.items()):
-        text = render_prometheus({key: 1.0})
+        if key.startswith(HISTOGRAM_KEY_PREFIX):
+            # histogram families render through the histogram exposition
+            # path — the derived _bucket/_sum/_count sample names and the
+            # le label are what must survive the validator
+            text = render_prometheus_histograms(
+                {hist_family(key): probe_hist.state()})
+        else:
+            text = render_prometheus({key: 1.0})
         errors = validate_prometheus_text(text)
         for err in errors:
             out.append(Finding(

@@ -842,7 +842,7 @@ class RolloutWorker:
 
     def __init__(self, worker_id: int, coordinator: FleetCoordinator,
                  transport: FleetTransport, meter=None, faults=None,
-                 tracer=None, lineage=None):
+                 tracer=None, lineage=None, latency=None):
         self.worker_id = worker_id
         self._coord = coordinator
         self._transport = transport
@@ -850,6 +850,11 @@ class RolloutWorker:
         self._faults = faults
         self._tracer = tracer
         self._lineage = lineage
+        # telemetry.LatencyHub: dispatch→device-ready per generation —
+        # the fleet's generation-wall + TTFT-upper-bound sketches. All
+        # workers share ONE hub: its histograms are mergeable, but
+        # in-process threads can simply record centrally.
+        self._latency = latency
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -942,6 +947,13 @@ class RolloutWorker:
                 t1 = time.perf_counter()
                 if self._meter is not None:
                     self._meter.note_gen(t0, t1, track=self.worker_id)
+                if self._latency is not None and self._latency.enabled:
+                    # one pair per generation event: keeps the TTFT
+                    # sketch's _count equal to the ledger's generation-
+                    # event count (the monolithic sampler is one jit, so
+                    # dispatch→ready is the TTFT upper bound here)
+                    self._latency.record("latency/generation_s", t1 - t0)
+                    self._latency.record("latency/ttft_s", t1 - t0)
                 if self._lineage is not None and self._lineage.enabled:
                     self._lineage.generation(
                         index, policy_version=version,
@@ -1018,6 +1030,7 @@ class FleetOrchestrator:
         lineage=None,
         transport: str = "inprocess",
         rpc=None,
+        latency=None,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers} must be >= 1")
@@ -1026,7 +1039,8 @@ class FleetOrchestrator:
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
         self.queue = BoundedStalenessQueue(
-            max_staleness, policy, start_index=start_index, lineage=lineage
+            max_staleness, policy, start_index=start_index, lineage=lineage,
+            latency=latency,
         )
         self.meter = meter if meter is not None else OverlapMeter()
         self.max_staleness = max_staleness
@@ -1034,6 +1048,7 @@ class FleetOrchestrator:
         self._faults = faults
         self._tracer = tracer
         self._lineage = lineage
+        self._latency = latency
         self.coordinator = FleetCoordinator(
             queue=self.queue, batch_fn=batch_fn, start_index=start_index,
             config=fleet, faults=faults, tracer=tracer, meter=self.meter,
@@ -1092,7 +1107,7 @@ class FleetOrchestrator:
             # the worker loop itself is identical to the in-process one
             client = self._rpc_mod.RpcClient(
                 self._rpc_server.address, wid, config=self._rpc_cfg,
-                faults=self._faults,
+                faults=self._faults, latency=self._latency,
             )
             self._rpc_clients.append(client)
             coord = self._rpc_mod.RemoteCoordinator(
@@ -1106,6 +1121,7 @@ class FleetOrchestrator:
         w = RolloutWorker(
             wid, coord, transport, meter=self.meter,
             faults=self._faults, tracer=self._tracer, lineage=self._lineage,
+            latency=self._latency,
         )
         # register BEFORE start: the worker's first acquire must find its
         # membership record (alive() treats not-yet-started as alive)

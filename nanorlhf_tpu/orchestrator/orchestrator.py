@@ -228,11 +228,13 @@ class RolloutOrchestrator:
         faults=None,
         tracer=None,
         lineage=None,
+        latency=None,
     ):
         self.store = VersionedWeightStore()
         self.store.publish(initial_params)  # version 0
         self.queue = BoundedStalenessQueue(
-            max_staleness, policy, start_index=start_index, lineage=lineage
+            max_staleness, policy, start_index=start_index, lineage=lineage,
+            latency=latency,
         )
         if restore:
             self.queue.restore_counters(restore)
@@ -248,6 +250,13 @@ class RolloutOrchestrator:
         # telemetry.LineageLedger: per-index lease + generation provenance
         # (the single producer is "worker 0" with an implicit lease)
         self._lineage = lineage
+        # telemetry.LatencyHub: generation-wall + TTFT histograms. The
+        # monolithic sampler is one jit (prefill + while_loop), so the
+        # first token is not separately observable without splitting the
+        # compiled graph; dispatch→device-ready is recorded as the TTFT
+        # UPPER BOUND (exact per-request TTFT comes from the paged
+        # scheduler's admission stamps — docs/OBSERVABILITY.md §7).
+        self._latency = latency
         self.producer_error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -299,6 +308,12 @@ class RolloutOrchestrator:
                     jax.block_until_ready(payload)
                 t1 = time.perf_counter()
                 self.meter.note_gen(t0, t1)
+                if self._latency is not None and self._latency.enabled:
+                    # one observation per generation event, so the TTFT
+                    # sketch's _count stays joinable against the lineage
+                    # ledger's generation-event count
+                    self._latency.record("latency/generation_s", t1 - t0)
+                    self._latency.record("latency/ttft_s", t1 - t0)
                 if lin is not None and lin.enabled:
                     lin.generation(
                         idx, policy_version=version, worker_id=0,

@@ -731,11 +731,17 @@ class RpcClient:
     connection loss. Thread-compatible: a lock serializes wire use."""
 
     def __init__(self, address: tuple[str, int], worker_id: int,
-                 config: Optional[RpcConfig] = None, faults=None):
+                 config: Optional[RpcConfig] = None, faults=None,
+                 latency=None):
         self.address = (address[0], int(address[1]))
         self.worker_id = int(worker_id)
         self.cfg = config or RpcConfig()
         self._faults = faults
+        # telemetry.LatencyHub: per-call-kind RTT histograms — one
+        # `latency/rpc_<op>_s` family per op, recorded at the same site
+        # as the rtt_ewma_s fold (telemetry.hist ranks above rpc.client
+        # in LOCK_ORDER, so recording under self._lock is order-legal)
+        self._latency = latency
         self._lock = make_rlock("rpc.client")
         self._sock: Optional[socket.socket] = None
         self._seq = 0
@@ -856,6 +862,8 @@ class RpcClient:
                 self.rtt_ewma_s = rtt if self.rtt_ewma_s <= 0.0 else (
                     a * rtt + (1 - a) * self.rtt_ewma_s
                 )
+                if self._latency is not None and self._latency.enabled:
+                    self._latency.record(f"latency/rpc_{op}_s", rtt)
             if "error" in resp:
                 raise RemoteCallError(resp["error"])
             return resp
@@ -908,6 +916,8 @@ class RpcClient:
                 self.rtt_ewma_s = rtt if self.rtt_ewma_s <= 0.0 else (
                     a * rtt + (1 - a) * self.rtt_ewma_s
                 )
+                if self._latency is not None and self._latency.enabled:
+                    self._latency.record("latency/rpc_fetch_weights_s", rtt)
                 return self._cache_version, tree
 
         def on_retry(_i, _e):

@@ -54,7 +54,7 @@ class QueuedSample:
 
 class BoundedStalenessQueue:
     def __init__(self, max_staleness: int, policy: str = "wait",
-                 start_index: int = 0, lineage=None):
+                 start_index: int = 0, lineage=None, latency=None):
         if max_staleness < 0:
             raise ValueError(f"max_staleness={max_staleness} must be >= 0")
         if policy not in ("wait", "drop"):
@@ -65,6 +65,11 @@ class BoundedStalenessQueue:
         # enqueue/dequeue monotonic times + staleness at consumption — and
         # stale-drop attribution. None/disabled = no-op.
         self._lineage = lineage
+        # LatencyHub (telemetry/hist.py): per-sample queue-wait histogram,
+        # recorded at dequeue. telemetry.hist ranks above
+        # orchestrator.queue in LOCK_ORDER, so recording under _cond is
+        # order-legal. None/disabled = no-op.
+        self._latency = latency
         self.maxsize = max_staleness + 1
         self._base = start_index     # gate arithmetic is RELATIVE to this
         self._q: collections.deque[QueuedSample] = collections.deque()
@@ -174,6 +179,16 @@ class BoundedStalenessQueue:
                     self.staleness_counts[staleness] = (
                         self.staleness_counts.get(staleness, 0) + 1
                     )
+                    if (self._latency is not None and self._latency.enabled
+                            and s.ready_time > 0.0):
+                        # dequeue − device-ready, both on the producer's
+                        # monotonic clock: the sample's true queue wait
+                        # (unstamped samples — plain-dict unit-test
+                        # payloads — carry ready_time 0.0 and are skipped)
+                        self._latency.record(
+                            "latency/queue_wait_s",
+                            time.perf_counter() - s.ready_time,
+                        )
                     if (self._lineage is not None
                             and self._lineage.enabled):
                         # dispatch/ready stamps share the producer's clock

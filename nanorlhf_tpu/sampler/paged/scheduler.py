@@ -46,6 +46,7 @@ the previous owner never leak through the masked attention.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -245,12 +246,20 @@ def generate_tokens_queued(
     sync_every: int = 8,
     spec_stats_out: list | None = None,
     paged_stats_out: list | None = None,
+    latency=None,
 ):
     """Host-driven continuous-batching generation: `generate_tokens`
     contract over the whole queue ([Q, max_tokens] int32 in queue order, or
     (tokens, logprobs) with capture), with only `decode_rows` rows resident
     at a time and finished rows' pages recycled to the next queued prompt
-    mid-loop. See the module docstring for scheduling/determinism notes."""
+    mid-loop. See the module docstring for scheduling/determinism notes.
+
+    `latency` (telemetry.LatencyHub, optional): records TRUE per-request
+    TTFT — admission-start → first-token-ready, blocking on the admission
+    prefill's sampled token — for the initial batch and every mid-loop
+    admission, plus the mean inter-token gap per sync chunk (chunk wall /
+    iterations advanced). The extra device syncs happen ONLY when a hub is
+    attached; the default path's async chunk pipeline is untouched."""
     Q, Tp = prompt_ids.shape
     R = min(int(decode_rows), Q)
     P = int(page_size)
@@ -259,10 +268,13 @@ def generate_tokens_queued(
     N = R * nb
     spec = spec_k > 0
 
+    hub = latency if (latency is not None and latency.enabled) else None
+
     # ---- initial admission: batch-prefill the first R prompts. The fresh
     # pool is fully claimed by the identity table (exactly what
     # _prefill_state builds), so the allocator starts with an EMPTY free
     # list; release/alloc churn begins at the first EOS.
+    t_prefill0 = time.perf_counter()
     base = _prefill_state_jit(
         params, config, prompt_ids[:R], prompt_mask[:R], key,
         max_tokens=max_tokens, eos_token_id=eos_token_id,
@@ -272,6 +284,13 @@ def generate_tokens_queued(
         page_size=P,
     )
     (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
+    if hub is not None:
+        # every initial-batch row's first token exists once this prefill
+        # lands: one TTFT observation per admitted request
+        jax.block_until_ready(tok0)
+        ttft0 = time.perf_counter() - t_prefill0
+        for _ in range(R):
+            hub.record("latency/ttft_s", ttft0)
     pstate = PageState(free=jnp.arange(N, dtype=jnp.int32),
                        top=jnp.asarray(0, jnp.int32),
                        table=full_table(R, nb))
@@ -307,7 +326,9 @@ def generate_tokens_queued(
     admissions: list[dict] = []
     util_samples: list[float] = []
 
+    it_prev = int(state[0]) - 1
     while True:
+        t_chunk0 = time.perf_counter()
         if spec:
             state = _spec_chunk(params, config, state, pstate.table,
                                 prompt_rep, **statics)
@@ -316,6 +337,13 @@ def generate_tokens_queued(
                                   **statics)
         done_h = np.asarray(state[5])
         it_now = int(state[0]) - 1
+        if hub is not None:
+            # done_h forced the device sync, so the chunk's wall time is
+            # fully realised here; one mean inter-token gap per sync chunk
+            hub.record("latency/intertoken_s",
+                       (time.perf_counter() - t_chunk0)
+                       / max(1, it_now - it_prev))
+        it_prev = it_now
         if spec:
             row_acc_h = np.asarray(state[14])
 
@@ -337,6 +365,7 @@ def generate_tokens_queued(
             next_q += 1
             pstate, ok = _alloc_jit(pstate, r, nb)
             assert bool(ok), "allocator underflow: full-budget rows recycle uniformly"
+            t_admit0 = time.perf_counter()
             caches, t0, l0, pl = _admit_one(
                 params, config, prompt_ids[q:q + 1], prompt_mask[q:q + 1],
                 state[3], pstate.table[r],
@@ -345,6 +374,12 @@ def generate_tokens_queued(
                 top_p=top_p, greedy=greedy, top_k=top_k,
                 approx_top_k=approx_top_k, lora_scale=lora_scale,
             )
+            if hub is not None:
+                # t0 is the admission prefill's sampled first token:
+                # blocking on it gives this request's true TTFT
+                jax.block_until_ready(t0)
+                hub.record("latency/ttft_s",
+                           time.perf_counter() - t_admit0)
             state = _install_row(
                 state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
                 max_tokens=max_tokens, eos_token_id=eos_token_id,

@@ -502,6 +502,7 @@ def generate(
     spec_stats_out: list | None = None,
     tracer=None,
     paged_stats_out: list | None = None,
+    latency=None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
     prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
@@ -523,7 +524,12 @@ def generate(
     cache — a dict with page_utilization / pages_recycled /
     admitted_midloop (+ per-admission records on the continuous-batching
     path) feeding the trainer's rollout/page_* metrics, the /statusz
-    `pages` section, and lineage lease events."""
+    `pages` section, and lineage lease events.
+
+    `latency` (an enabled telemetry.LatencyHub): the queued paged path
+    records true per-request TTFT and per-sync-chunk inter-token gaps
+    into it (hist.py); the monolithic one-jit paths ignore it — their
+    dispatch→ready wall is recorded by the orchestrator instead."""
     total_rows = prompt_ids.shape[0] * sampling.n
     queued = (sampling.page_size > 0 and sampling.decode_rows > 0
               and sampling.decode_rows < total_rows)
@@ -560,6 +566,7 @@ def generate(
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k,
             spec_stats_out=spec_stats_out, paged_stats_out=paged_stats_out,
+            latency=latency,
         )
     if sampling.spec_k > 0:
         if sampling.compaction_segments > 0:

@@ -12,13 +12,20 @@ parent can load any of them by file path."""
 from nanorlhf_tpu.telemetry.exporter import (
     StatusExporter,
     render_prometheus,
+    render_prometheus_histograms,
     validate_prometheus_text,
 )
 from nanorlhf_tpu.telemetry.health import (
     DEFAULT_RULES,
+    SLO_RULES,
     HealthConfig,
     HealthMonitor,
     HealthRule,
+)
+from nanorlhf_tpu.telemetry.hist import (
+    LatencyHub,
+    StreamingHistogram,
+    percentiles_from_samples,
 )
 from nanorlhf_tpu.telemetry.lineage import (
     LineageLedger,
@@ -49,18 +56,23 @@ __all__ = [
     "HealthConfig",
     "HealthMonitor",
     "HealthRule",
+    "LatencyHub",
     "LineageLedger",
     "PEAK_FLOPS_PER_CHIP",
     "RecompileCounter",
+    "SLO_RULES",
     "SpanTracer",
     "StatusExporter",
+    "StreamingHistogram",
     "chains",
     "drop_histogram",
     "flops_param_count",
     "peak_flops_per_chip",
+    "percentiles_from_samples",
     "read_ledger",
     "recompile_counter",
     "render_prometheus",
+    "render_prometheus_histograms",
     "update_flops",
     "validate_prometheus_text",
     "validate_trace_events",

@@ -5,7 +5,9 @@ post-mortem; this is the read side — a `ThreadingHTTPServer` on a daemon
 thread that lets a human `curl` a running trainer or a monitor scrape it:
 
 - `/metrics`  Prometheus text exposition of the latest scalar metrics row
-              (MetricsLogger.latest()) merged with the live health gauges
+              (MetricsLogger.latest()) merged with the live health gauges,
+              plus the latency histogram families (`_bucket`/`_sum`/
+              `_count`) when a LatencyHub is attached
 - `/healthz`  200/503 straight from the HealthMonitor verdict — the shape
               k8s-style liveness probes expect
 - `/statusz`  one JSON blob of run state: step, policy version, staleness,
@@ -76,6 +78,33 @@ def render_prometheus(metrics: dict, prefix: str = "nanorlhf_") -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_prometheus_histograms(states: dict, prefix: str = "nanorlhf_") -> str:
+    """Render {metric key: StreamingHistogram.state()} as Prometheus
+    histogram exposition (version 0.0.4): per family one `# TYPE name
+    histogram` line, cumulative `name_bucket{le="..."}` series at the
+    sketch's coarse export edges (exact — the edges align with internal
+    bucket boundaries), the mandatory `le="+Inf"` bucket equal to
+    `name_count`, then `name_sum` and `name_count`. Keys sanitize exactly
+    like `render_prometheus` (`latency/ttft_s` → `nanorlhf_latency_ttft_s`)
+    so the gauge and histogram surfaces share one naming rule."""
+    from nanorlhf_tpu.telemetry.hist import StreamingHistogram
+
+    lines: list[str] = []
+    for key in sorted(states):
+        try:
+            h = StreamingHistogram.load(states[key])
+        except Exception:
+            continue  # a torn/foreign state must not kill the scrape
+        name = prefix + _NAME_RE.sub("_", str(key))
+        lines.append(f"# TYPE {name} histogram")
+        for edge, cum in h.cumulative_buckets():
+            lines.append(f'{name}_bucket{{le="{edge:.6g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{name}_sum {repr(h.sum)}")
+        lines.append(f"{name}_count {h.count}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def validate_prometheus_text(text: str) -> list[str]:
     """Validate Prometheus text exposition; return a list of problems
     (empty == valid). Shared by the test suite and the CI health-smoke
@@ -114,13 +143,14 @@ class StatusExporter:
     def __init__(self, port: int, *,
                  metrics_fn: Optional[Callable[[], dict]] = None,
                  statusz_fn: Optional[Callable[[], dict]] = None,
-                 health=None, host: str = "127.0.0.1"):
+                 health=None, latency=None, host: str = "127.0.0.1"):
         self.enabled = bool(port)
         self.host = host
         self.port = 0
         self._metrics_fn = metrics_fn
         self._statusz_fn = statusz_fn
         self._health = health
+        self._latency = latency  # LatencyHub: /metrics histogram families
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -179,6 +209,8 @@ class StatusExporter:
         if self._health is not None:
             merged.update(self._health.gauges())
         text = render_prometheus(merged)
+        if self._latency is not None and self._latency.enabled:
+            text += render_prometheus_histograms(self._latency.states())
         return 200, "text/plain", text.encode()
 
     def _healthz(self) -> tuple:

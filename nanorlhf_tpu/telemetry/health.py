@@ -273,6 +273,12 @@ class HealthRule:
     - "above_abs": last value >= threshold
     - "rate_above": windowed per-second rate of a cumulative counter
                   >= threshold
+    - "quantile_above": the `quantile` of the attached LatencyHub's
+                  histogram named by `metric` >= threshold seconds (the
+                  SLO rule shape — p95 TTFT, p99 queue wait, RPC RTT).
+                  `warmup` counts histogram SAMPLES, not metric rows;
+                  evaluates OK when no hub is attached, so the rule set
+                  is safe on monitors without a latency surface.
     """
 
     name: str
@@ -282,6 +288,7 @@ class HealthRule:
     crit: float
     warmup: int = 8          # min observations of the metric before firing
     description: str = ""
+    quantile: float = 0.95   # quantile_above only: which quantile to score
 
 
 DEFAULT_RULES: tuple = (
@@ -331,6 +338,31 @@ DEFAULT_RULES: tuple = (
                            "expiry fires)"),
 )
 
+# SLO rules over latency-histogram quantiles (docs/OBSERVABILITY.md §7) —
+# the verdicts ROADMAP item 5's autoscaler consumes. Kept OUT of
+# DEFAULT_RULES: they only evaluate against an attached LatencyHub, and
+# the trainers append them when cfg.latency is on. Thresholds are
+# deliberately generous for the CPU CI rig (a cold-compile generation
+# wall lands in the TTFT sketch); production overrides pass a custom
+# rule tuple through HealthConfig.
+SLO_RULES: tuple = (
+    HealthRule("slo_ttft_p95", "latency/ttft_s",
+               "quantile_above", warn=60.0, crit=300.0,
+               warmup=16, quantile=0.95,
+               description="p95 admission-to-first-token over the SLO"),
+    HealthRule("slo_queue_wait_p99", "latency/queue_wait_s",
+               "quantile_above", warn=10.0, crit=60.0,
+               warmup=16, quantile=0.99,
+               description="p99 sample queue wait over the SLO (trainer "
+                           "about to starve or producer racing ahead)"),
+    HealthRule("slo_rpc_rtt_p95", "latency/rpc_heartbeat_s",
+               "quantile_above", warn=1.0, crit=5.0,
+               warmup=16, quantile=0.95,
+               description="p95 heartbeat RTT over the SLO (control-plane "
+                           "link degradation — heartbeats are small and "
+                           "frequent, so their RTT isolates the wire)"),
+)
+
 
 @dataclasses.dataclass
 class HealthConfig:
@@ -366,13 +398,18 @@ class HealthMonitor:
     def __init__(self, config: Optional[HealthConfig] = None, tracer=None,
                  blackbox_fn: Optional[Callable] = None,
                  on_crit: Optional[Callable] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 latency=None):
         self.cfg = config or HealthConfig()
         self.enabled = bool(self.cfg.enabled)
         self._tracer = tracer
         self._blackbox_fn = blackbox_fn
         self._on_crit = on_crit
         self._clock = clock
+        # LatencyHub the quantile_above (SLO) rules read; hub quantile
+        # reads acquire telemetry.hist, ranked ABOVE telemetry.health in
+        # LOCK_ORDER, so reading it during rule eval is order-legal
+        self._latency = latency
         self._lock = make_lock("telemetry.health")
         self._aggs: dict[str, MetricAggregate] = {}
         self._rates: dict[str, WindowedRate] = {
@@ -387,6 +424,11 @@ class HealthMonitor:
         self._verdict = OK
         self.rows = 0        # metric rows observed
         self.trips = 0       # OK/WARN -> CRIT transitions
+
+    def attach_latency(self, hub) -> None:
+        """Wire a LatencyHub after construction (the trainer builds the
+        hub and the monitor in either order)."""
+        self._latency = hub
 
     # ---------------------------------------------------------------- #
     # observation
@@ -481,8 +523,12 @@ class HealthMonitor:
     def _eval_rule_locked(self, rule: HealthRule) -> tuple:
         """-> (level, signal, detail). The signal is the breach magnitude in
         the rule's own units (z-score, fraction-of-median, rate/s)."""
-        agg = self._aggs.get(rule.metric)
         warmup = rule.warmup if rule.warmup else self.cfg.warmup
+        if rule.kind == "quantile_above":
+            # histogram-backed: no MetricAggregate exists for the metric
+            # (it names a latency sketch, not a row) — gate on the sketch
+            return self._eval_quantile_rule(rule, warmup)
+        agg = self._aggs.get(rule.metric)
         if agg is None or agg.count < max(int(warmup), 1):
             return OK, 0.0, ""
         if rule.kind in ("drop_z", "rise_z"):
@@ -523,6 +569,29 @@ class HealthMonitor:
                 if level != OK else ""
             )
         raise ValueError(f"unknown rule kind {rule.kind!r}")
+
+    def _eval_quantile_rule(self, rule: HealthRule, warmup) -> tuple:
+        """SLO rule: score one quantile of an attached latency histogram
+        against absolute-seconds thresholds. The warmup gate counts the
+        SKETCH's samples (rule.metric names a histogram, not a metric
+        row), so a rule cannot fire off two noisy observations. The agg
+        warmup gate in _eval_rule_locked does not apply — histograms fill
+        many samples per metric row."""
+        hub = self._latency
+        if hub is None or not getattr(hub, "enabled", False):
+            return OK, 0.0, ""
+        if hub.count(rule.metric) < max(int(warmup), 1):
+            return OK, 0.0, ""
+        v = hub.quantile(rule.metric, rule.quantile)
+        if not math.isfinite(v):
+            return OK, 0.0, ""
+        level = (CRIT if v >= rule.crit
+                 else WARN if v >= rule.warn else OK)
+        return level, v, (
+            f"p{rule.quantile * 100:g}={v:.4g}s "
+            f"(warn>={rule.warn:g}s crit>={rule.crit:g}s)"
+            if level != OK else ""
+        )
 
     def _verdict_locked(self) -> str:
         worst = max(_LEVELS[l] for l in self._rule_levels.values()) \

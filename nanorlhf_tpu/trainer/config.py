@@ -422,6 +422,15 @@ class RLConfig:
     # a sampled index keeps its COMPLETE lease→...→outcome chain; others
     # are skipped at every layer). Drop counters stay exact regardless.
     lineage_sample_rate: float = 1.0
+    # latency surface (telemetry/hist.py, docs/OBSERVABILITY.md §7):
+    # log-bucketed mergeable streaming histograms over every
+    # latency-bearing path — admission→first-token (TTFT), inter-token
+    # gaps, queue wait, per-op RPC RTT, reward-grader wall, per-update
+    # phase durations — journaled in trainer_state.json, rendered as
+    # Prometheus histogram exposition on /metrics, and scored by the
+    # quantile SLO rules (health.SLO_RULES). On by default; the bench
+    # A/B (detail.latency) holds the overhead under 1% of step wall.
+    latency: bool = True
 
     # ---- checkpoint / eval / logging ----
     save_steps: int = 1

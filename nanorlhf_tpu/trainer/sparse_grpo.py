@@ -361,7 +361,7 @@ class SparseGRPOTrainer(RLTrainer):
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale,
                 spec_stats_out=spec_stats, tracer=self.tracer,
-                paged_stats_out=paged_stats,
+                paged_stats_out=paged_stats, latency=self.latency,
             )
             return {"queries": queries, "gen_out": gen_out,
                     "spec_stats": spec_stats[0] if spec_stats else None,
@@ -439,6 +439,12 @@ class SparseGRPOTrainer(RLTrainer):
             raw_scores = self._call_reward(
                 [q + r for q, r in zip(question_n, decoded)], responses
             )
+            if self.latency.enabled:
+                # grader wall — same quantity the lineage reward event
+                # records as wall_s (the sympy/subprocess graders are the
+                # dominant host cost in the r1 path)
+                self.latency.record("latency/reward_s",
+                                    time.perf_counter() - t_rwd0)
             self.lineage.reward(
                 rollout_index, step=self.state["global_step"],
                 scores=[round(float(s), 6) for s in raw_scores.tolist()],
@@ -736,6 +742,11 @@ class SparseGRPOTrainer(RLTrainer):
                 rollout_s=rollout_s,
                 update_s=update_s,
             ))
+            if self.latency.enabled:
+                # per-update phase durations — the sparse loop times its two
+                # phases by hand instead of PhaseTimer, same histogram keys
+                self.latency.record("latency/phase_rollout_s", rollout_s)
+                self.latency.record("latency/phase_update_s", update_s)
             self.state["global_step"] += 1
             if self.accuracy_func is not None and cfg.eval_steps and \
                     self.state["global_step"] % cfg.eval_steps == 0:
@@ -856,5 +867,6 @@ class SparseGRPOTrainer(RLTrainer):
                              "watchdog": self.watchdog.journal(),
                          },
                          "health": self.health.journal(),
-                         "lineage": self.lineage.journal()},
+                         "lineage": self.lineage.journal(),
+                         "latency": self.latency.journal()},
         )

@@ -73,8 +73,9 @@ from nanorlhf_tpu.ops.masking import (
 from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
                                         shard_params)
 from nanorlhf_tpu.sampler import SamplingParams, generate
-from nanorlhf_tpu.telemetry import (HealthConfig, HealthMonitor,
-                                    LineageLedger, SpanTracer,
+from nanorlhf_tpu.telemetry import (DEFAULT_RULES, HealthConfig,
+                                    HealthMonitor, LatencyHub,
+                                    LineageLedger, SLO_RULES, SpanTracer,
                                     StatusExporter, flops_param_count,
                                     peak_flops_per_chip, recompile_counter,
                                     update_flops)
@@ -594,10 +595,21 @@ class RLTrainer:
             sample_rate=config.lineage_sample_rate,
             key_path="fold_in(fold_in(seed_key, 0x5E11), rollout_index)",
         )
+        # latency surface (telemetry/hist.py, docs/OBSERVABILITY.md §7):
+        # one mergeable log-bucketed histogram per latency/* key — TTFT,
+        # inter-token gap, queue wait, RPC RTT, reward wall, phase
+        # durations. Disabled, record() is a cheap no-op so every
+        # instrumentation site stays inline (bench's detail.latency A/B
+        # is the overhead gate).
+        self.latency = LatencyHub(enabled=config.latency)
         # run-health plane (telemetry/health.py, docs/OBSERVABILITY.md §5):
         # every metrics row folds through streaming aggregates + anomaly
         # rules; CRIT dumps a reason="health" blackbox through the tracer
         # (a no-op when telemetry is off) and optionally arms the sentinel.
+        # With the latency surface on, the quantile SLO rules ride along
+        # and read the hub's histograms directly (p95 TTFT, p99 queue
+        # wait, p95 RPC RTT — docs/OBSERVABILITY.md §7).
+        rules = DEFAULT_RULES + (SLO_RULES if config.latency else ())
         self.health = HealthMonitor(
             HealthConfig(
                 enabled=config.health,
@@ -607,10 +619,12 @@ class RLTrainer:
                 window_s=config.health_window_s,
                 max_events=config.health_max_events,
                 blackbox_on_crit=config.health_blackbox_on_crit,
+                rules=rules,
             ),
             tracer=self.tracer,
             blackbox_fn=self._health_blackbox,
             on_crit=self._on_health_crit,
+            latency=self.latency,
         )
         # live status endpoints (telemetry/exporter.py): off unless
         # cfg.status_port is set (-1 = ephemeral — tests/CI)
@@ -620,6 +634,7 @@ class RLTrainer:
             metrics_fn=self._export_metrics,
             health=self.health,
             statusz_fn=self._statusz,
+            latency=self.latency,
         )
         from nanorlhf_tpu.utils.profiling import PhaseTimer, ProfileWindow
 
@@ -801,6 +816,7 @@ class RLTrainer:
                     faults=self.faults,
                     tracer=self.tracer,
                     lineage=self.lineage,
+                    latency=self.latency,
                     fleet=FleetConfig(
                         lease_size=cfg.fleet_lease_size,
                         failure_budget=cfg.fleet_failure_budget,
@@ -840,6 +856,7 @@ class RLTrainer:
                     faults=self.faults,
                     tracer=self.tracer,
                     lineage=self.lineage,
+                    latency=self.latency,
                 )
             self._orch_restore_state = None
         return self._orchestrator
@@ -1009,6 +1026,10 @@ class RLTrainer:
             # drop-reason counts since start + the last-N sample ring
             # (telemetry/lineage.py) — the live companion to the ledger
             "lineage": self.lineage.statusz(),
+            # latency surface (telemetry/hist.py): per-key count/mean/
+            # p50/p95/p99/min/max from the streaming histograms; {} when
+            # cfg.latency is off
+            "latency": self.latency.snapshot(),
             # paged KV cache (rollout_page_size > 0): latest rollout's pool
             # occupancy / recycling / mid-loop admission snapshot; None when
             # the lever is off
@@ -1560,7 +1581,7 @@ class RLTrainer:
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
                 lora_scale=self.lora_scale, batch_sharding=bs,
                 spec_stats_out=spec_stats, tracer=self.tracer,
-                paged_stats_out=paged_stats,
+                paged_stats_out=paged_stats, latency=self.latency,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:
@@ -2170,7 +2191,17 @@ class RLTrainer:
                 rollout_s=self.timer.totals.get("rollout", 0.0),
                 update_s=self.timer.totals.get("update", 0.0),
             ))
-            metrics.update(self.timer.summary())
+            phase_rows = self.timer.summary()
+            metrics.update(phase_rows)
+            if self.latency.enabled:
+                # per-update phase durations into the latency surface: the
+                # time/{phase}_s gauges above are the LAST update's splits,
+                # the latency/phase_{phase}_s histograms hold every update's
+                for k, v in phase_rows.items():
+                    if k.startswith("time/") and k.endswith("_s"):
+                        # "time/rollout_s" -> "latency/phase_rollout_s"
+                        self.latency.record(
+                            f"latency/phase_{k[5:-2]}_s", float(v))
             self.state["global_step"] += 1
             # run-health plane: fold this row into the streaming aggregates,
             # evaluate the anomaly rules, and ride the health/* gauges on
@@ -2350,7 +2381,11 @@ class RLTrainer:
                        # lineage journal: monotonic event index + drop
                        # counters, so a resumed ledger appends to the
                        # stream instead of restarting it
-                       "lineage": self.lineage.journal()}
+                       "lineage": self.lineage.journal(),
+                       # latency journal: full histogram states (sparse
+                       # bucket counts + scheme), so resumed quantiles
+                       # keep the whole run's distribution
+                       "latency": self.latency.journal()}
         if orch is not None:
             # journal the queue: pending (dispatched, unconsumed)
             # indices + cumulative drop/staleness counters. Resume
@@ -2406,6 +2441,11 @@ class RLTrainer:
                 attempt, attempts=self.cfg.reward_retries + 1,
                 backoff_base=0.1,
             )
+        if self.latency.enabled:
+            # grader wall incl. retry backoff — the same quantity the
+            # lineage reward event records as wall_s
+            self.latency.record("latency/reward_s",
+                                time.perf_counter() - t0)
         if rollout_index is not None:
             self.lineage.reward(
                 rollout_index, step=step,
@@ -2544,6 +2584,13 @@ class RLTrainer:
         lj = tstate.get("lineage")
         if lj:
             self.lineage.restore(lj)
+        # latency journal: reload every histogram's bucket counts so
+        # post-resume quantiles cover the whole run (SchemeMismatch — a
+        # checkpoint from a different bucket scheme — propagates: mixing
+        # schemes would silently corrupt every quantile)
+        hj = tstate.get("latency")
+        if hj:
+            self.latency.restore(hj)
         self._reset_data_iterator()
         return self.state
 

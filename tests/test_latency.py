@@ -1,0 +1,499 @@
+"""Latency surface (nanorlhf_tpu/telemetry/hist.py + exporter.py + SLO
+rules in health.py, docs/OBSERVABILITY.md §7) — the tier-1
+`latency-smoke` CI gate:
+
+- log-bucketed streaming histograms track exact percentiles within one
+  bucket width on adversarial distributions (bimodal, heavy-tail,
+  constant) and clamp under/overflow to the observed extremes;
+- merge is EXACT bucket-wise addition: associative across 3 worker
+  sketches, equal to recording every sample centrally, and scheme drift
+  raises SchemeMismatch instead of merging garbage;
+- the journal (`trainer_state.json` "latency") round-trips through JSON
+  exactly and a resumed trainer restores the sketches bit-for-bit;
+- `render_prometheus_histograms` emits valid exposition (the SHARED
+  validate_prometheus_text check): monotone `_bucket{le=...}` series,
+  the mandatory `le="+Inf"` bucket, `_sum`/`_count`;
+- a synthetic queue-wait burst walks the p99 SLO rule OK→CRIT through
+  the health plane, lands a blackbox dump, and respects the
+  sample-count warmup; no attached hub means the rules stay OK;
+- `tools/inspect_run.py --latency` reconstructs queue-wait/generation
+  percentiles from the ledger ALONE and agrees with a live hub fed the
+  same samples;
+- a 2-update GRPO run with 2 rollout workers over the rpc transport
+  serves Prometheus-valid TTFT/queue-wait histograms on /metrics whose
+  `_count` equals the lineage ledger's generation/queue event counts.
+"""
+
+import json
+import math
+import os
+import random
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nanorlhf_tpu.telemetry import (
+    DEFAULT_RULES,
+    HealthConfig,
+    HealthMonitor,
+    LatencyHub,
+    LineageLedger,
+    SLO_RULES,
+    StreamingHistogram,
+    percentiles_from_samples,
+    read_ledger,
+    render_prometheus_histograms,
+    validate_prometheus_text,
+)
+from nanorlhf_tpu.telemetry.health import CRIT, OK, WARN
+from nanorlhf_tpu.telemetry.hist import (
+    EXPORT_EDGE_INDICES,
+    HIST_BUCKETS,
+    HIST_LO,
+    SchemeMismatch,
+    bucket_lower,
+)
+from nanorlhf_tpu.trainer import AlgoName
+
+from test_trainer_smoke import make_trainer
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "inspect_run.py")
+
+# one log-bucket's relative width: 10^(1/32) - 1 ≈ 7.5% — the histogram's
+# quantile error bound on a distribution with ties at the probed ranks
+BUCKET_REL = 10 ** (1 / 32) - 1
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _run_inspect(run_dir, *args):
+    out = subprocess.run(
+        [sys.executable, TOOLS, str(run_dir), *args, "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _clone(h):
+    return StreamingHistogram.load(h.state())
+
+
+# ---------------------------------------------------------------------------
+# sketch mechanics (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_export_edges_cover_half_decades():
+    # the Prometheus edges run every half decade from 10 µs to 1000 s and
+    # align with internal bucket boundaries (what makes cumulative counts
+    # exact rather than resampled)
+    edges = [bucket_lower(i) for i in EXPORT_EDGE_INDICES]
+    assert edges[0] == pytest.approx(1e-5)
+    assert edges[-1] == pytest.approx(1e3)
+    for a, b in zip(edges, edges[1:]):
+        assert b / a == pytest.approx(math.sqrt(10.0))
+
+
+@pytest.mark.parametrize("dist", ["bimodal", "heavy_tail", "constant"])
+def test_quantile_tracks_numpy_on_adversarial_distributions(dist):
+    rng = random.Random(0)
+    if dist == "bimodal":
+        # 40/60 mix: the probed ranks land INSIDE a mode, not in the gap
+        # (a rank exactly at the gap has no well-defined percentile to
+        # within bucket width — no estimator beats the gap's span)
+        xs = [abs(rng.gauss(0.002, 0.0003)) for _ in range(8000)]
+        xs += [abs(rng.gauss(5.0, 0.5)) for _ in range(12000)]
+    elif dist == "heavy_tail":
+        xs = [math.exp(rng.gauss(-3.0, 2.0)) for _ in range(20000)]
+    else:
+        xs = [0.0123] * 5000
+    h = StreamingHistogram()
+    for x in xs:
+        h.record(x)
+    assert h.count == len(xs)
+    if dist == "constant":
+        # min == max: quantiles clamp to the single observed value exactly
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            assert h.quantile(q) == 0.0123
+        assert h.mean == pytest.approx(0.0123)
+        return
+    for q in (0.50, 0.95, 0.99):
+        true = float(np.percentile(xs, 100 * q))
+        got = h.quantile(q)
+        assert abs(got - true) / true < 0.08, (dist, q, got, true)
+    assert h.mean == pytest.approx(sum(xs) / len(xs))
+
+
+def test_underflow_overflow_participate_and_clamp():
+    h = StreamingHistogram()
+    for v in (1e-9, 1e-8, 0.5, 2e4):
+        h.record(v)
+    assert h.count == 4
+    # the out-of-range samples landed in the under/overflow buckets
+    assert -1 in h.counts and HIST_BUCKETS in h.counts
+    # extremes are tracked exactly and bound every quantile
+    assert h.min == 1e-9 and h.max == 2e4
+    assert h.quantile(1.0) == 2e4
+    assert h.quantile(0.1) == pytest.approx(HIST_LO)  # underflow reports floor
+    assert 0.4 < h.quantile(0.6) < 0.6                # the in-range sample
+    # a NaN is a caller bug and must not poison the sketch
+    h.record(float("nan"))
+    assert h.count == 4
+    # negative (impossible monotonic difference) clamps to zero, not a crash
+    h.record(-1.0)
+    assert h.count == 5 and h.min == 0.0
+
+
+def test_merge_is_exact_and_associative():
+    rng = random.Random(1)
+    a, b, c = StreamingHistogram(), StreamingHistogram(), StreamingHistogram()
+    central = StreamingHistogram()
+    for h, mu in ((a, -6.0), (b, -2.0), (c, 1.0)):
+        for _ in range(3000):
+            v = math.exp(rng.gauss(mu, 1.0))
+            h.record(v)
+            central.record(v)
+    ab_c = _clone(a).merge(_clone(b)).merge(_clone(c))
+    a_bc = _clone(a).merge(_clone(b).merge(_clone(c)))
+    for m in (ab_c, a_bc):
+        # bucket counts and extremes are bit-identical to central recording
+        assert m.counts == central.counts
+        assert m.count == central.count == 9000
+        assert (m.min, m.max) == (central.min, central.max)
+        # quantiles depend only on counts + extremes → also bit-identical
+        for q in (0.01, 0.5, 0.95, 0.999):
+            assert m.quantile(q) == central.quantile(q)
+        # float addition order can differ in the last ulp — that's the only
+        # non-exactness merge allows
+        assert m.sum == pytest.approx(central.sum, rel=1e-12)
+
+
+def test_hub_merge_states_folds_worker_sketches():
+    rng = random.Random(2)
+    workers = [LatencyHub() for _ in range(3)]
+    central = LatencyHub()
+    for w in workers:
+        for _ in range(500):
+            v = math.exp(rng.gauss(-3.0, 1.0))
+            w.record("latency/ttft_s", v)
+            central.record("latency/ttft_s", v)
+    coord1, coord2 = LatencyHub(), LatencyHub()
+    for w in workers:
+        coord1.merge_states(w.states())
+    for w in reversed(workers):
+        coord2.merge_states(w.states())
+    assert coord1.count("latency/ttft_s") == \
+        coord2.count("latency/ttft_s") == 1500
+    for q in (0.5, 0.99):
+        assert coord1.quantile("latency/ttft_s", q) == \
+            coord2.quantile("latency/ttft_s", q) == \
+            central.quantile("latency/ttft_s", q)
+    # scheme drift rejects the merge instead of silently mixing boundaries
+    bad = workers[0].states()
+    bad["latency/ttft_s"]["scheme"] = [1e-6, 11, 32]
+    with pytest.raises(SchemeMismatch):
+        coord1.merge_states(bad)
+
+
+def test_journal_roundtrips_through_json_exactly():
+    hub = LatencyHub()
+    rng = random.Random(3)
+    for _ in range(200):
+        hub.record("latency/queue_wait_s", math.exp(rng.gauss(-4.0, 1.5)))
+        hub.record("latency/reward_s", rng.random())
+    # through JSON — the exact trip trainer_state.json takes
+    j = json.loads(json.dumps(hub.journal()))
+    back = LatencyHub()
+    back.restore(j)
+    assert back.journal() == hub.journal()
+    # the restored hub keeps recording on the same trajectory
+    hub.record("latency/reward_s", 0.25)
+    back.record("latency/reward_s", 0.25)
+    assert back.journal() == hub.journal()
+    # a journal from a different bucket scheme must refuse to load
+    j["hists"]["latency/reward_s"]["scheme"] = [1e-9, 11, 32]
+    with pytest.raises(SchemeMismatch):
+        LatencyHub().restore(j)
+
+
+def test_disabled_hub_is_a_noop():
+    hub = LatencyHub(enabled=False)
+    hub.record("latency/ttft_s", 1.0)
+    hub.merge_states(LatencyHub().states())
+    assert hub.names() == []
+    assert hub.count("latency/ttft_s") == 0
+    assert math.isnan(hub.quantile("latency/ttft_s", 0.5))
+    assert hub.snapshot() == {} and hub.journal() == {"hists": {}}
+
+
+def test_percentiles_from_samples_matches_numpy():
+    rng = random.Random(4)
+    xs = [rng.lognormvariate(-2.0, 1.0) for _ in range(1000)]
+    d = percentiles_from_samples(xs)
+    assert d["count"] == 1000
+    for key, q in (("p50_s", 50), ("p95_s", 95), ("p99_s", 99)):
+        assert d[key] == pytest.approx(float(np.percentile(xs, q)))
+    assert d["min_s"] == min(xs) and d["max_s"] == max(xs)
+    empty = percentiles_from_samples([])
+    assert empty["count"] == 0 and empty["p99_s"] is None
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histogram exposition
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_histograms_is_valid_exposition():
+    hub = LatencyHub()
+    rng = random.Random(5)
+    for _ in range(400):
+        hub.record("latency/ttft_s", math.exp(rng.gauss(-1.0, 1.0)))
+        hub.record("latency/queue_wait_s", math.exp(rng.gauss(-5.0, 2.0)))
+    text = render_prometheus_histograms(hub.states())
+    assert validate_prometheus_text(text) == []
+    for fam in ("nanorlhf_latency_ttft_s", "nanorlhf_latency_queue_wait_s"):
+        assert f"# TYPE {fam} histogram" in text
+        buckets = re.findall(
+            rf'^{fam}_bucket{{le="([^"]+)"}} (\d+)$', text, re.M)
+        assert buckets[-1][0] == "+Inf"
+        cums = [int(c) for _, c in buckets]
+        assert cums == sorted(cums)          # cumulative → monotone
+        assert cums[-1] == 400
+        m = re.search(rf"^{fam}_count (\d+)$", text, re.M)
+        assert m and int(m.group(1)) == 400  # _count == le="+Inf" bucket
+        assert re.search(rf"^{fam}_sum \S+$", text, re.M)
+    # a torn/foreign state is skipped, never a scrape crash
+    assert render_prometheus_histograms(
+        {"latency/x_s": {"scheme": [1, 2, 3]}}) == ""
+    assert render_prometheus_histograms({}) == ""
+
+
+# ---------------------------------------------------------------------------
+# SLO rules through the health plane
+# ---------------------------------------------------------------------------
+
+
+def test_queue_wait_burst_flips_p99_slo_ok_to_crit_with_blackbox():
+    hub = LatencyHub()
+    dumps = []
+    mon = HealthMonitor(
+        HealthConfig(rules=DEFAULT_RULES + SLO_RULES),
+        blackbox_fn=lambda step, extra: dumps.append((step, extra)),
+        latency=hub,
+    )
+    # warmup counts histogram SAMPLES (not metric rows): 15 pathological
+    # waits are still below the 16-sample gate
+    for _ in range(15):
+        hub.record("latency/queue_wait_s", 120.0)
+    rows = mon.observe(1, {})
+    assert rows["health/rule_slo_queue_wait_p99"] == 0.0
+    assert mon.verdict == OK and not dumps
+    # the burst clears warmup: p99 ≈ 120 s >> crit 60 s → one trip,
+    # one flight-recorder blackbox
+    for _ in range(35):
+        hub.record("latency/queue_wait_s", 120.0)
+    rows = mon.observe(2, {})
+    assert rows["health/rule_slo_queue_wait_p99"] == 2.0
+    assert mon.verdict == CRIT and mon.trips == 1
+    assert len(dumps) == 1
+    step, extra = dumps[0]
+    assert step == 2 and "slo_queue_wait_p99" in extra["rules"]
+
+
+def test_slo_warn_band_and_no_hub_stays_ok():
+    # 90 s p95 TTFT sits between warn (60) and crit (300)
+    hub = LatencyHub()
+    for _ in range(20):
+        hub.record("latency/ttft_s", 90.0)
+    mon = HealthMonitor(HealthConfig(rules=SLO_RULES), latency=hub)
+    rows = mon.observe(1, {})
+    assert rows["health/rule_slo_ttft_p95"] == 1.0
+    assert mon.verdict == WARN
+    # without an attached hub the SLO rules evaluate OK — the rule tuple
+    # is safe on monitors that have no latency surface
+    bare = HealthMonitor(HealthConfig(rules=SLO_RULES))
+    rows = bare.observe(1, {})
+    assert all(v == 0.0 for k, v in rows.items()
+               if k.startswith("health/rule_slo_"))
+    assert bare.verdict == OK
+
+
+# ---------------------------------------------------------------------------
+# registry: histogram metric shape
+# ---------------------------------------------------------------------------
+
+
+def test_registry_folds_histogram_suffixes_to_base_family():
+    from nanorlhf_tpu.analysis.registry import hist_family
+
+    base = "latency/ttft_s"
+    for suffixed in (f'{base}_bucket{{le="0.01"}}',
+                     f'{base}_bucket{{le="+Inf"}}',
+                     f"{base}_bucket", f"{base}_sum", f"{base}_count"):
+        assert hist_family(suffixed) == base
+    # the base family maps to itself; non-latency keys are untouched even
+    # with histogram-looking suffixes
+    assert hist_family(base) == base
+    assert hist_family("perf/mfu_count") == "perf/mfu_count"
+
+
+# ---------------------------------------------------------------------------
+# offline reconstruction (tools/inspect_run.py --latency)
+# ---------------------------------------------------------------------------
+
+
+def test_inspect_run_latency_matches_live_hub(tmp_path):
+    # one synthetic run, two recording paths: the ledger's queue/generation
+    # events and a live hub fed the SAME samples. The inspector's exact
+    # percentiles and the hub's bucketed quantiles must agree to within
+    # one bucket width. Values come from a small grid (ties at every
+    # probed rank) so the exact percentile is well-defined.
+    grid = [2e-4, 1e-3, 5e-3, 0.02, 0.1, 0.5, 2.0, 8.0]
+    led = LineageLedger(str(tmp_path))
+    hub = LatencyHub()
+    rng = random.Random(7)
+    base = 100.0
+    for i in range(64):
+        w = grid[rng.randrange(len(grid))]
+        g = grid[rng.randrange(len(grid))]
+        led.queue(i, enqueue_t=base, dequeue_t=base + w, staleness=0)
+        led.generation(i, policy_version=1, worker_id=0, gen_s=round(g, 6))
+        hub.record("latency/queue_wait_s", w)
+        hub.record("latency/generation_s", g)
+    led.close()
+    rep = _run_inspect(tmp_path, "--latency")["latency"]
+    for fam, key in (("queue_wait_s", "latency/queue_wait_s"),
+                     ("generation_s", "latency/generation_s")):
+        offline = rep[fam]
+        assert offline["count"] == hub.count(key) == 64
+        assert offline["min_s"] == pytest.approx(
+            hub.snapshot()[key]["min_s"], abs=1e-6)
+        assert offline["max_s"] == pytest.approx(
+            hub.snapshot()[key]["max_s"], abs=1e-6)
+        for pkey, q in (("p50_s", 0.50), ("p95_s", 0.95)):
+            live = hub.quantile(key, q)
+            assert abs(live - offline[pkey]) / offline[pkey] \
+                <= BUCKET_REL + 1e-6, (fam, pkey, live, offline[pkey])
+
+
+# ---------------------------------------------------------------------------
+# trainer integration (the latency-smoke acceptance runs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # runs in the named latency-smoke CI step
+def test_fleet_rpc_histograms_join_ledger_and_serve_metrics(tmp_path):
+    """ISSUE-13 acceptance: 2 rollout workers over the rpc transport, 2
+    GRPO updates — /metrics serves Prometheus-valid TTFT and queue-wait
+    histograms whose `_count` equals the lineage ledger's generation- and
+    queue-event counts, and the inspector's offline view agrees with the
+    live hub."""
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                      save_steps=0, rollout_orchestrator=True,
+                      rollout_workers=2, max_staleness=2,
+                      rollout_transport="rpc", lineage=True,
+                      status_port=-1)
+    tr.train()
+    # train() returned but the fleet keeps prefetching until the staleness
+    # gate blocks it; wait for quiescence (counts stable across 3 reads)
+    # so the scrape, the live hub, and the ledger all see the same events
+    stable, prev = 0, (-1, -1)
+    for _ in range(30):
+        cur = (tr.latency.count("latency/ttft_s"),
+               tr.latency.count("latency/queue_wait_s"))
+        stable = stable + 1 if cur == prev else 0
+        if stable >= 3:
+            break
+        prev = cur
+        time.sleep(1.0)
+    port = tr.exporter.port
+    body = _get(f"http://127.0.0.1:{port}/metrics")
+    statusz = json.loads(_get(f"http://127.0.0.1:{port}/statusz"))
+    live_ttft = tr.latency.count("latency/ttft_s")
+    live_qw = tr.latency.count("latency/queue_wait_s")
+    snap = tr.latency.snapshot()
+    tr.close()
+
+    assert validate_prometheus_text(body) == []
+    counts = {fam: int(n) for fam, n in re.findall(
+        r"^nanorlhf_(latency_\w+)_count (\d+)$", body, re.M)}
+    assert counts["latency_ttft_s"] == live_ttft > 0
+    assert counts["latency_queue_wait_s"] == live_qw > 0
+    assert 'nanorlhf_latency_ttft_s_bucket{le="+Inf"}' in body
+    # cfg.latency (on by default) appended the SLO rules to the monitor
+    assert "nanorlhf_health_rule_slo_ttft_p95" in body
+    # /statusz carries the digest view of the same sketches
+    assert statusz["latency"]["latency/ttft_s"]["count"] == live_ttft
+
+    # the join: one TTFT observation per ledger generation event, one
+    # queue-wait observation per ledger queue event
+    events = list(read_ledger(str(tmp_path / "grpo")))
+    gen_events = [ev for ev in events if ev["type"] == "generation"]
+    queue_events = [ev for ev in events if ev["type"] == "queue"]
+    assert live_ttft == len(gen_events)
+    assert live_qw == len(queue_events)
+    # the rpc transport's per-op RTT sketches recorded too
+    assert any(n.startswith("latency/rpc_") for n in snap)
+    # per-update phase splits landed as histograms
+    assert snap["latency/phase_rollout_s"]["count"] >= 2
+
+    # offline reconstruction from the ledger alone agrees with the live
+    # hub: same event counts, same exact extremes (gen_s is journaled
+    # rounded to 1 µs)
+    rep = _run_inspect(tmp_path / "grpo", "--latency")["latency"]
+    assert rep["generation_s"]["count"] == len(gen_events)
+    assert rep["queue_wait_s"]["count"] == len(queue_events)
+    assert rep["generation_s"]["max_s"] == pytest.approx(
+        snap["latency/generation_s"]["max_s"], abs=1e-4)
+    qw_live_p95 = tr.latency.quantile("latency/queue_wait_s", 0.95)
+    assert rep["queue_wait_s"]["min_s"] - 1e-6 <= qw_live_p95 \
+        <= rep["queue_wait_s"]["max_s"] + 1e-6
+
+
+@pytest.mark.slow  # runs in the named latency-smoke CI step
+def test_latency_journal_resumes_across_checkpoint(tmp_path):
+    tr1 = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32)
+    tr1.train()
+    tr1.close()
+    # journaled beside "health"/"lineage" in trainer_state.json
+    tstate = tr1.ckpt.load_trainer_state(2)
+    j_ckpt = tstate["latency"]
+    assert j_ckpt["hists"], "2 updates must journal latency sketches"
+    assert "latency/phase_update_s" in j_ckpt["hists"]
+    tr2 = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=64)
+    tr2.resume_from_checkpoint()
+    # bit-for-bit restore through the JSON journal
+    assert tr2.latency.journal() == j_ckpt
+    before = {n: tr2.latency.count(n) for n in tr2.latency.names()}
+    tr2.train(num_updates=1)
+    tr2.close()
+    # the resumed run keeps accumulating into the restored sketches
+    assert tr2.latency.count("latency/phase_update_s") > \
+        before["latency/phase_update_s"]
+    assert all(tr2.latency.count(n) >= c for n, c in before.items())
+
+
+@pytest.mark.slow  # runs in the named latency-smoke CI step
+def test_latency_off_disables_surface_and_slo_rules(tmp_path):
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                      latency=False)
+    tr.train()
+    tr.close()
+    assert not tr.latency.enabled
+    assert tr.latency.names() == []
+    # no SLO rules on the monitor when the surface is off
+    assert all(not name.startswith("slo_")
+               for name in tr.health.snapshot()["rules"])
+    tstate = tr.ckpt.load_trainer_state(2)
+    assert tstate.get("latency", {"hists": {}})["hists"] == {}

@@ -15,6 +15,9 @@ scores from `sample`/`reward` events the ledger already carries).
                                                       # lease→generation→queue
                                                       # →reward→outcome
   python tools/inspect_run.py RUN_DIR --drops --json  # machine-readable out
+  python tools/inspect_run.py RUN_DIR --latency       # queue-wait + generation
+                                                      # percentiles from the
+                                                      # ledger alone
 
 RUN_DIR is the trainer's output_dir (containing `lineage/`) or the lineage
 directory itself. jax-free: runs anywhere the JSONL files can be read.
@@ -27,11 +30,41 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from nanorlhf_tpu.telemetry.hist import (  # noqa: E402
+    percentiles_from_samples,
+)
 from nanorlhf_tpu.telemetry.lineage import (  # noqa: E402
     chains,
     drop_histogram,
     read_ledger,
 )
+
+
+def latency_report(events) -> dict:
+    """Reconstruct latency percentiles from the ledger ALONE: queue wait
+    from each `queue` event's dequeue_t − enqueue_t (both on the producer's
+    monotonic clock) and generation duration from each `generation` event's
+    gen_s. Summaries use the same percentile definition the live
+    LatencyHub cross-checks against (hist.percentiles_from_samples), so a
+    live run's `latency/queue_wait_s` / `latency/generation_s` histograms
+    and this offline view disagree by at most one histogram bucket."""
+    queue_waits = [
+        ev["dequeue_t"] - ev["enqueue_t"]
+        for ev in events
+        if ev.get("type") == "queue"
+        and isinstance(ev.get("dequeue_t"), (int, float))
+        and isinstance(ev.get("enqueue_t"), (int, float))
+        and ev["enqueue_t"] > 0.0
+    ]
+    gen_s = [
+        ev["gen_s"] for ev in events
+        if ev.get("type") == "generation"
+        and isinstance(ev.get("gen_s"), (int, float))
+    ]
+    return {
+        "queue_wait_s": percentiles_from_samples(queue_waits),
+        "generation_s": percentiles_from_samples(gen_s),
+    }
 
 
 def _fmt_time(ev, t0):
@@ -132,6 +165,9 @@ def main():
                     help="N worst-reward samples with text + timeline")
     ap.add_argument("--index", type=int, default=None,
                     help="full event chain for one rollout index")
+    ap.add_argument("--latency", action="store_true",
+                    help="queue-wait + generation percentiles reconstructed "
+                         "from the ledger (no live trainer needed)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
@@ -155,6 +191,22 @@ def main():
                 print(f"  {reason:<24s} {count}")
             if not hist:
                 print("  (no drops recorded)")
+        return 0
+
+    if args.latency:
+        rep = latency_report(events)
+        if args.json:
+            print(json.dumps({"latency": rep}, sort_keys=True))
+            return 0
+        print("latency percentiles (reconstructed from the ledger):")
+        for name, summ in sorted(rep.items()):
+            if not summ["count"]:
+                print(f"  {name:<16s} (no events)")
+                continue
+            print(f"  {name:<16s} n={summ['count']:<6d} "
+                  f"p50={summ['p50_s']:.4f}s p95={summ['p95_s']:.4f}s "
+                  f"p99={summ['p99_s']:.4f}s "
+                  f"mean={summ['mean_s']:.4f}s max={summ['max_s']:.4f}s")
         return 0
 
     if args.index is not None:
