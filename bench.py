@@ -54,6 +54,13 @@ machinery's cost on the step wall; acceptance < 2%, docs/FLEET.md — plus,
 budget permitting, the same fleet over the loopback RpcTransport and
 detail.fleet.rpc_transport_overhead_frac, the socket framing/codec cost;
 acceptance < 5% at 2 workers, docs/FLEET.md §multi-host),
+BENCH_PAGED (1: also run the continuous-batching A/B and report
+detail.paged — queued-paged vs contiguous fixed-batch at equal resident
+batch on a long-tail corpus, docs/PAGED_CACHE.md),
+BENCH_SERVING (1: also run the radix prefix-cache A/B and report
+detail.serving — radix on vs off at equal resident batch on a >= 50%
+prompt-overlap corpus; acceptance prefix_hit_frac > 0.4 with strictly
+fewer dispatched prefill tokens, greedy bit-identical, docs/SERVING.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -620,6 +627,125 @@ def _paged_check(jax) -> dict:
         "paged_check": "ok" if (
             identical and queued_dispatches < fixed_dispatches
             and sec_q < sec_f
+        ) else "MISMATCH",
+    }
+
+
+def _serving_check(jax) -> dict:
+    """Cross-request radix prefix-cache A/B (ISSUE 14, docs/SERVING.md):
+    the SAME queued paged scheduler at the SAME resident batch, radix
+    cache on vs off, over a corpus where >= 50% of prompts share an
+    8-real-token prefix with an earlier prompt (two prefix families x 8
+    prompts, distinct 2-token tails). With the cache on, every repeat
+    admission installs the matched prefix's pages by refcount and
+    prefills only its suffix, so `prefill_token_dispatch` (tokens
+    actually pushed through prefill/suffix forwards — the FLOPs proxy)
+    must be STRICTLY lower and `prefix_hit_frac` must clear 0.4; greedy
+    output must stay bit-identical (the rollout-parity pin from
+    tests/test_serving.py, re-checked here at bench scale). TTFT
+    percentiles come from untimed hub-attached re-runs — admission
+    syncs would perturb the timed A/B. Gate with BENCH_SERVING=0."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+    from nanorlhf_tpu.serving.radix import RadixCache
+    from nanorlhf_tpu.telemetry.hist import LatencyHub
+
+    V, R, P, Tp, resp = 64, 2, 4, 12, 24
+    EOS, PAD = 3, 0
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=V)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    # same deterministic machine as the paged check: zeroed layers +
+    # identity embedding make greedy generation a pure token permutation,
+    # so each prompt's length is chosen by its last real token
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    for t in range(10, 50):                             # chains -> EOS
+        sigma[t] = t + 1
+    sigma[50] = EOS
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    # two 8-token prefix families, 8 prompts each, distinct 2-token
+    # tails (tail state sets the greedy length): after each family's
+    # first (cold) admission the other 7 are 8-real-token prefix hits —
+    # 14/16 prompts overlap an earlier one
+    fam_a, fam_b = [9] * 8, list(range(21, 29))
+    tails = [(51 + i % 4, s) for i, s in enumerate(
+        [44, 46, 40, 47, 42, 45, 41, 48])]
+    reals = ([fam_a + list(t) for t in tails]
+             + [fam_b + list(t) for t in tails])
+    prompts = np.full((len(reals), Tp), PAD, np.int32)
+    for i, rtoks in enumerate(reals):
+        prompts[i, Tp - len(rtoks):] = rtoks
+    ids, mask = jnp.asarray(prompts), jnp.asarray(prompts != PAD)
+    sp = SamplingParams(greedy=True, max_tokens=resp,
+                        page_size=P, decode_rows=R)
+    kw = dict(eos_token_id=EOS, pad_token_id=PAD)
+
+    def run(cache, latency=None):
+        pst: list = []
+        out = np.asarray(generate(
+            params, mcfg, ids, mask, jax.random.PRNGKey(0), sp,
+            paged_stats_out=pst, latency=latency, prefix_cache=cache,
+            **kw))
+        return out, pst[-1]
+
+    walls = {}
+    for name, cache in (("off", None), ("on", RadixCache())):
+        for rep in range(2):                            # compile + 1 timed
+            t0 = time.time()
+            out, stats = run(cache)
+            walls[name] = (out, stats, time.time() - t0)
+
+    lat_cols = {}
+    for name, cache in (("off", None), ("on", RadixCache())):
+        hub = LatencyHub()
+        run(cache, latency=hub)
+        if hub.count("latency/ttft_s"):
+            lat_cols[f"ttft_p50_s_{name}"] = round(
+                hub.quantile("latency/ttft_s", 0.50), 5)
+            lat_cols[f"ttft_p95_s_{name}"] = round(
+                hub.quantile("latency/ttft_s", 0.95), 5)
+
+    out_off, st_off, sec_off = walls["off"]
+    out_on, st_on, sec_on = walls["on"]
+    tokens = int((out_off != PAD).sum())
+    disp_off = int(st_off["prefill_token_dispatch"])
+    disp_on = int(st_on["prefill_token_dispatch"])
+    hit_frac = float(st_on["prefix_hit_frac"])
+    identical = bool(np.array_equal(out_off, out_on))
+    return {
+        "queue_length": len(reals),
+        "decode_rows": R,
+        "page_size": P,
+        "prompt_len": Tp,
+        "overlap_frac": round(14 / 16, 3),
+        "tokens_emitted": tokens,
+        "prefix_hit_frac": round(hit_frac, 4),
+        "prefix_hit_tokens": int(st_on["prefix_hit_tokens"]),
+        "cow_splits": int(st_on["cow_splits"]),
+        "evicted_pages": int(st_on["evicted_pages"]),
+        "shared_pages_peak": int(st_on["shared_pages"]),
+        "prefill_token_dispatch_off": disp_off,
+        "prefill_token_dispatch_on": disp_on,
+        "tokens_per_sec_off": round(tokens / sec_off, 1),
+        "tokens_per_sec_on": round(tokens / sec_on, 1),
+        "sec_off": round(sec_off, 3),
+        "sec_on": round(sec_on, 3),
+        **lat_cols,
+        "greedy_bit_identical": identical,
+        "serving_check": "ok" if (
+            identical and disp_on < disp_off and hit_frac > 0.4
         ) else "MISMATCH",
     }
 
@@ -1288,6 +1414,16 @@ def run_bench(jax, init_error):
             paged_detail = _paged_check(jax)
         except Exception as e:
             paged_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    serving_detail = None
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            # radix prefix-cache A/B (tiny model, any backend) — the
+            # ISSUE-14 gate: >= 50% prompt overlap must clear
+            # prefix_hit_frac 0.4 with strictly fewer dispatched prefill
+            # tokens at equal resident batch, greedy bit-identical
+            serving_detail = _serving_check(jax)
+        except Exception as e:
+            serving_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     detail = {
         "backend": backend,
@@ -1309,6 +1445,7 @@ def run_bench(jax, init_error):
         "kv_cache_quant": kv_cache_quant,
         "spec_decode": spec_decode_detail,
         **({"paged": paged_detail} if paged_detail is not None else {}),
+        **({"serving": serving_detail} if serving_detail is not None else {}),
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

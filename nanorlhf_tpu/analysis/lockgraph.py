@@ -2,7 +2,8 @@
 
 Extracts, from the AST alone, the "may acquire B while holding A" graph
 across the threaded modules (orchestrator/, telemetry/,
-trainer/metrics.py, resilience/faults.py) and checks it against the
+trainer/metrics.py, resilience/faults.py, serving/) and checks it
+against the
 declared partial order in `lockorder.LOCK_ORDER`:
 
   lockorder.undeclared  a raw threading.Lock/RLock/Condition() in a
@@ -39,6 +40,7 @@ SCOPE = (
     "nanorlhf_tpu/telemetry/",
     "nanorlhf_tpu/trainer/metrics.py",
     "nanorlhf_tpu/resilience/faults.py",
+    "nanorlhf_tpu/serving/",
 )
 
 # attr-name -> class-name receiver table for resolving self._attr.m() calls.
@@ -59,6 +61,10 @@ RECEIVER_TYPES: dict[str, str] = {
     "_metrics": "MetricsLogger",
     "_client": "RpcClient",
     "_server": "FleetRpcServer",
+    "_latency": "LatencyHub",
+    "_hub": "LatencyHub",
+    "_radix": "RadixCache",
+    "_engine": "ServingEngine",
 }
 
 # attrs that hold a bound method of another class (callable attributes).

@@ -42,12 +42,18 @@ import threading
 #   {orchestrator.queue, rpc.client, telemetry.health} -> telemetry.hist
 #     (queue-wait / RPC-RTT recording under the holder's lock; SLO rule
 #      evaluation reads hub quantiles under the health monitor's lock)
+#   serving.engine -> telemetry.hist
+#     (the shed check reads hub TTFT quantiles under the engine's
+#      condition; serving.radix is ranked just below serving.engine so
+#      an admission that ever plans under the condition stays ascending)
 LOCK_ORDER: tuple[str, ...] = (
     "fleet.coordinator",      # FleetCoordinator._cond      (fleet.py)
     "orchestrator.queue",     # BoundedStalenessQueue._cond (sample_queue.py)
     "orchestrator.weights",   # VersionedWeightStore._cond  (weight_store.py)
     "rpc.server",             # FleetRpcServer._lock        (rpc.py)
     "rpc.client",             # RpcClient._lock             (rpc.py)
+    "serving.engine",         # ServingEngine._cond         (serving/engine.py)
+    "serving.radix",          # RadixCache._lock            (serving/radix.py)
     "trainer.metrics",        # MetricsLogger._lock         (metrics.py)
     "telemetry.health",       # HealthMonitor._lock         (health.py)
     "telemetry.hist",         # LatencyHub._lock            (hist.py)

@@ -51,6 +51,7 @@ METRIC_SCOPES = (
     "nanorlhf_tpu/orchestrator/",
     "nanorlhf_tpu/telemetry/",
     "nanorlhf_tpu/sampler/",
+    "nanorlhf_tpu/serving/",             # gateway/engine emit serving/*
     "nanorlhf_tpu/utils/profiling.py",   # PhaseTimer emits time/{k}_s
 )
 

@@ -95,7 +95,17 @@ def release_row(state: PageState, row) -> Tuple[PageState, jnp.ndarray]:
     """Push `table[row]`'s live pages back onto the free stack and reset the
     row to sentinel.  Returns `(new_state, n_released)`.  Releasing an
     already-sentinel row is a no-op (returns 0), so the scheduler may release
-    idempotently at every sync."""
+    idempotently at every sync.
+
+    Semantics under refcounting: a release decrements the row's hold AT MOST
+    ONCE — the sentinel reset is what makes the second release of the same
+    row a no-op rather than a double-free that would push the same page onto
+    the free stack twice.  The host-side refcounted pool
+    (`serving.radix.RefPagePool`) mirrors this contract at the row level:
+    `RadixCache.release` skips sentinel entries, so releasing a row's table
+    twice frees its refs exactly once, while a raw `RefPagePool.unref` past
+    zero is a hard error (the invariant tests in tests/test_serving.py pin
+    both)."""
     nb = state.table.shape[1]
     num_pages = state.free.shape[0]
     pages = state.table[row]

@@ -222,6 +222,18 @@ _release_jit = jax.jit(release_row)
 _alloc_jit = jax.jit(alloc_row)
 
 
+@partial(jax.jit, static_argnames=("temperature", "top_p", "greedy", "top_k",
+                                   "approx_top_k"))
+def _admit_sample(logits, key, *, temperature, top_p, greedy, top_k,
+                  approx_top_k):
+    """First token + logprob from a single row's admission logits [V] —
+    the sampling half of `_admit_one`, split out so the radix path can
+    feed it suffix-prefill logits instead of full-prefill logits."""
+    tok0 = _sample_token(key, logits[None, :], temperature, top_p, greedy,
+                         top_k, approx_top_k)
+    return tok0[0], _token_logprob(logits[None, :], tok0, temperature)[0]
+
+
 def generate_tokens_queued(
     params: dict,
     config,
@@ -247,6 +259,7 @@ def generate_tokens_queued(
     spec_stats_out: list | None = None,
     paged_stats_out: list | None = None,
     latency=None,
+    prefix_cache=None,
 ):
     """Host-driven continuous-batching generation: `generate_tokens`
     contract over the whole queue ([Q, max_tokens] int32 in queue order, or
@@ -259,7 +272,20 @@ def generate_tokens_queued(
     prefill's sampled token — for the initial batch and every mid-loop
     admission, plus the mean inter-token gap per sync chunk (chunk wall /
     iterations advanced). The extra device syncs happen ONLY when a hub is
-    attached; the default path's async chunk pipeline is untouched."""
+    attached; the default path's async chunk pipeline is untouched.
+
+    `prefix_cache` (serving.radix.RadixCache, optional): admissions route
+    through the cross-request radix prefix cache instead of the device
+    free-stack allocator — a request whose padded prompt prefix is already
+    cached installs the matched full pages by refcount inc alone (zero
+    prefill FLOPs), COW-splits a mid-page straddler, and prefills only the
+    suffix through `suffix_logits`. The cache RESETS at the start of every
+    call (cached KV is tied to the params that wrote it — docs/SERVING.md),
+    so the win here is intra-call: the n>1 queued fanout and dataset-level
+    prompt repeats. Greedy streams stay bit-identical to the uncached path
+    (test-pinned); sampled streams are equal in distribution only (cold
+    initial rows draw tok0 from the per-queue-index admission fold instead
+    of the batched fold_in(key, 0)). Incompatible with spec_k > 0."""
     Q, Tp = prompt_ids.shape
     R = min(int(decode_rows), Q)
     P = int(page_size)
@@ -268,39 +294,141 @@ def generate_tokens_queued(
     N = R * nb
     spec = spec_k > 0
 
-    hub = latency if (latency is not None and latency.enabled) else None
+    radix = prefix_cache if (prefix_cache is not None
+                             and getattr(prefix_cache, "enabled", False)) \
+        else None
+    if radix is not None and spec:
+        raise ValueError(
+            "prefix_cache is incompatible with spec_k > 0: the radix "
+            "admission path derives per-row cache fill from the matched "
+            "prefix, which the speculative carry's per-row accept "
+            "bookkeeping does not model — run one lever at a time.")
 
-    # ---- initial admission: batch-prefill the first R prompts. The fresh
-    # pool is fully claimed by the identity table (exactly what
-    # _prefill_state builds), so the allocator starts with an EMPTY free
-    # list; release/alloc churn begins at the first EOS.
-    t_prefill0 = time.perf_counter()
-    base = _prefill_state_jit(
-        params, config, prompt_ids[:R], prompt_mask[:R], key,
-        max_tokens=max_tokens, eos_token_id=eos_token_id,
-        pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
-        greedy=greedy, lora_scale=lora_scale, top_k=top_k,
-        capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
-        page_size=P,
-    )
-    (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
-    if hub is not None:
-        # every initial-batch row's first token exists once this prefill
-        # lands: one TTFT observation per admitted request
-        jax.block_until_ready(tok0)
-        ttft0 = time.perf_counter() - t_prefill0
-        for _ in range(R):
-            hub.record("latency/ttft_s", ttft0)
-    pstate = PageState(free=jnp.arange(N, dtype=jnp.int32),
-                       top=jnp.asarray(0, jnp.int32),
-                       table=full_table(R, nb))
-    n_gen0 = jnp.ones((R,), jnp.int32)
-    if spec:
-        from nanorlhf_tpu.sampler.speculative import _spec_state
-        state = _spec_state(base)
+    hub = latency if (latency is not None and latency.enabled) else None
+    sample_kw = dict(temperature=temperature, top_p=top_p, greedy=greedy,
+                     top_k=top_k, approx_top_k=approx_top_k)
+
+    prompt_np = np.asarray(prompt_ids)
+    pmask_np = np.asarray(prompt_mask)
+    dispatch_tokens = 0            # Σ Tq over prefill/suffix dispatches —
+    hit_tokens = 0                 # the A/B's "prefill FLOPs" proxy
+    shared_peak = 0                # max pages/shared over sync points
+
+    if radix is not None:
+        from nanorlhf_tpu.core.model import init_paged_kv_cache
+        from nanorlhf_tpu.serving.radix import (
+            bucket_len, copy_page, prompt_key, suffix_logits,
+        )
+
+        N = R * nb + radix.extra_pages(R, nb)
+        radix.reset(num_pages=N, page_size=P)
+        stats0 = dict(radix.stats)
+        caches0 = init_paged_kv_cache(
+            config, N, P, params["embed_tokens"].dtype)
+        # empty carry: every row starts done; _radix_admit installs the
+        # initial batch through the same path mid-loop admissions use
+        state = (jnp.int32(1),
+                 jnp.full((R, max_tokens), pad_token_id, jnp.int32),
+                 jnp.zeros((R, max_tokens), jnp.float32),
+                 caches0,
+                 jnp.zeros((R, T_max), bool),
+                 jnp.ones((R,), bool),
+                 jnp.zeros((R,), jnp.int32),
+                 jnp.ones((R,), jnp.int32),
+                 jnp.zeros((R,), jnp.int32),
+                 key)
+        table_np = np.full((R, nb), N, np.int32)
+        pstate = None
+
+        def _radix_admit(q, r, state):
+            """Admit queue index `q` into resident row `r` through the
+            radix cache: refcount-share the matched full pages, COW-split
+            a mid-page straddler, prefill only the suffix."""
+            nonlocal dispatch_tokens, hit_tokens
+            t_admit0 = time.perf_counter()
+            toks, msk = prompt_np[q], pmask_np[q].astype(bool)
+            kelems = prompt_key(toks, msk)
+            pad_count = int(Tp - msk.sum())
+            plan = radix.plan(kelems, pad_count=pad_count, n_blocks=nb,
+                              prompt_len=Tp)
+            table_np[r] = plan.row_pages
+            admit_key = jax.random.fold_in(key, _ADMIT_BASE + q)
+            caches = state[3]
+            if plan.cow_src is not None:
+                caches = copy_page(caches, plan.cow_src, plan.cow_dst)
+            if plan.m == 0:
+                # cold: the row's pages are all fresh, so the full
+                # single-row prefill is IDENTICAL to the uncached path
+                caches, t0, l0, pl = _admit_one(
+                    params, config, prompt_ids[q:q + 1],
+                    prompt_mask[q:q + 1], caches,
+                    jnp.asarray(plan.row_pages), admit_key,
+                    page_size=P, T_max=T_max, lora_scale=lora_scale,
+                    **sample_kw)
+                dispatch_tokens += Tp
+            else:
+                m = plan.m
+                s_real = Tp - m
+                Sb = bucket_len(s_real, T_max - m)
+                suffix = np.zeros((1, Sb), np.int32)
+                suffix[0, :s_real] = toks[m:]
+                pos = (m - pad_count) + np.arange(Sb, dtype=np.int32)[None]
+                km = np.zeros((1, T_max), bool)
+                km[0, pad_count:m] = True
+                logits, caches = suffix_logits(
+                    params, config, jnp.asarray(suffix), jnp.asarray(pos),
+                    jnp.asarray([m], jnp.int32), jnp.int32(s_real - 1),
+                    jnp.asarray(km), caches, jnp.asarray(plan.row_pages),
+                    page_size=P, lora_scale=lora_scale)
+                t0, l0 = _admit_sample(logits, admit_key, **sample_kw)
+                pl = jnp.int32(int(msk.sum()))
+                dispatch_tokens += Sb
+                hit_tokens += plan.hit_tokens
+            radix.insert(kelems, plan.row_pages, Tp)
+            if hub is not None:
+                jax.block_until_ready(t0)
+                hub.record("latency/ttft_s",
+                           time.perf_counter() - t_admit0)
+            return _install_row(
+                state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
+                max_tokens=max_tokens, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id, spec=False)
+
+        for r in range(R):
+            state = _radix_admit(r, r, state)
     else:
-        state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0, tok0,
-                 n_gen0, plen0, key)
+        # ---- initial admission: batch-prefill the first R prompts. The
+        # fresh pool is fully claimed by the identity table (exactly what
+        # _prefill_state builds), so the allocator starts with an EMPTY
+        # free list; release/alloc churn begins at the first EOS.
+        t_prefill0 = time.perf_counter()
+        base = _prefill_state_jit(
+            params, config, prompt_ids[:R], prompt_mask[:R], key,
+            max_tokens=max_tokens, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
+            greedy=greedy, lora_scale=lora_scale, top_k=top_k,
+            capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
+            page_size=P,
+        )
+        (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
+        dispatch_tokens += R * Tp
+        if hub is not None:
+            # every initial-batch row's first token exists once this
+            # prefill lands: one TTFT observation per admitted request
+            jax.block_until_ready(tok0)
+            ttft0 = time.perf_counter() - t_prefill0
+            for _ in range(R):
+                hub.record("latency/ttft_s", ttft0)
+        pstate = PageState(free=jnp.arange(N, dtype=jnp.int32),
+                           top=jnp.asarray(0, jnp.int32),
+                           table=full_table(R, nb))
+        n_gen0 = jnp.ones((R,), jnp.int32)
+        if spec:
+            from nanorlhf_tpu.sampler.speculative import _spec_state
+            state = _spec_state(base)
+        else:
+            state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0,
+                     tok0, n_gen0, plen0, key)
 
     statics = dict(
         Tp=Tp, max_tokens=max_tokens, page_size=P, sync_every=int(sync_every),
@@ -317,8 +445,6 @@ def generate_tokens_queued(
     lp_all = np.zeros((Q, max_tokens), np.float32)
     acc_all = np.zeros((Q,), np.int64)            # spec: accepted drafts/row
     owner = list(range(R))                        # resident row → queue index
-    prompt_np = np.asarray(prompt_ids)
-    pmask_np = np.asarray(prompt_mask)
     prompt_res_np = np.array(prompt_np[:R])       # resident prompts (spec)
     prompt_rep = jnp.asarray(prompt_res_np)
     next_q = R
@@ -329,11 +455,13 @@ def generate_tokens_queued(
     it_prev = int(state[0]) - 1
     while True:
         t_chunk0 = time.perf_counter()
+        table_dev = (jnp.asarray(table_np) if radix is not None
+                     else pstate.table)
         if spec:
-            state = _spec_chunk(params, config, state, pstate.table,
+            state = _spec_chunk(params, config, state, table_dev,
                                 prompt_rep, **statics)
         else:
-            state = _decode_chunk(params, config, state, pstate.table,
+            state = _decode_chunk(params, config, state, table_dev,
                                   **statics)
         done_h = np.asarray(state[5])
         it_now = int(state[0]) - 1
@@ -356,35 +484,45 @@ def generate_tokens_queued(
             if spec:
                 acc_all[q] = int(row_acc_h[r])
             owner[r] = -1
-            pstate, m = _release_jit(pstate, r)
-            recycled += int(m)
+            if radix is not None:
+                # drop the REQUEST's refs; pages the tree still holds
+                # survive as cached prefix KV for later admissions
+                recycled += radix.release(table_np[r])
+                table_np[r] = N
+            else:
+                pstate, m = _release_jit(pstate, r)
+                recycled += int(m)
         for r in finished:
             if next_q >= Q:
                 continue
             q = next_q
             next_q += 1
-            pstate, ok = _alloc_jit(pstate, r, nb)
-            assert bool(ok), "allocator underflow: full-budget rows recycle uniformly"
-            t_admit0 = time.perf_counter()
-            caches, t0, l0, pl = _admit_one(
-                params, config, prompt_ids[q:q + 1], prompt_mask[q:q + 1],
-                state[3], pstate.table[r],
-                jax.random.fold_in(key, _ADMIT_BASE + q),
-                page_size=P, T_max=T_max, temperature=temperature,
-                top_p=top_p, greedy=greedy, top_k=top_k,
-                approx_top_k=approx_top_k, lora_scale=lora_scale,
-            )
-            if hub is not None:
-                # t0 is the admission prefill's sampled first token:
-                # blocking on it gives this request's true TTFT
-                jax.block_until_ready(t0)
-                hub.record("latency/ttft_s",
-                           time.perf_counter() - t_admit0)
-            state = _install_row(
-                state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
-                max_tokens=max_tokens, eos_token_id=eos_token_id,
-                pad_token_id=pad_token_id, spec=spec,
-            )
+            if radix is not None:
+                state = _radix_admit(q, r, state)
+            else:
+                pstate, ok = _alloc_jit(pstate, r, nb)
+                assert bool(ok), "allocator underflow: full-budget rows recycle uniformly"
+                t_admit0 = time.perf_counter()
+                caches, t0, l0, pl = _admit_one(
+                    params, config, prompt_ids[q:q + 1], prompt_mask[q:q + 1],
+                    state[3], pstate.table[r],
+                    jax.random.fold_in(key, _ADMIT_BASE + q),
+                    page_size=P, T_max=T_max, temperature=temperature,
+                    top_p=top_p, greedy=greedy, top_k=top_k,
+                    approx_top_k=approx_top_k, lora_scale=lora_scale,
+                )
+                dispatch_tokens += Tp
+                if hub is not None:
+                    # t0 is the admission prefill's sampled first token:
+                    # blocking on it gives this request's true TTFT
+                    jax.block_until_ready(t0)
+                    hub.record("latency/ttft_s",
+                               time.perf_counter() - t_admit0)
+                state = _install_row(
+                    state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
+                    max_tokens=max_tokens, eos_token_id=eos_token_id,
+                    pad_token_id=pad_token_id, spec=spec,
+                )
             owner[r] = q
             if spec:
                 prompt_res_np[r] = prompt_np[q]
@@ -392,13 +530,17 @@ def generate_tokens_queued(
             admissions.append({"row": r, "queue_index": q,
                                "iteration": it_now})
         # pool occupancy AFTER this sync's churn: allocated / total pages
-        util_samples.append(1.0 - float(np.asarray(pstate.top)) / N)
+        if radix is not None:
+            util_samples.append(1.0 - radix.pool.free_count / N)
+            shared_peak = max(shared_peak, radix.pool.shared_count())
+        else:
+            util_samples.append(1.0 - float(np.asarray(pstate.top)) / N)
         if next_q >= Q and all(o < 0 for o in owner):
             break
 
     n_iter = int(state[0]) - 1
     if paged_stats_out is not None:
-        paged_stats_out.append({
+        entry = {
             "page_utilization": float(np.mean(util_samples)),
             "pages_recycled": recycled,
             "admitted_midloop": len(admissions),
@@ -407,7 +549,20 @@ def generate_tokens_queued(
             "num_pages": N,
             "page_size": P,
             "admissions": admissions,
-        })
+            "prefill_token_dispatch": dispatch_tokens,
+        }
+        if radix is not None:
+            lookup_tok = radix.stats["lookup_tokens"] - stats0["lookup_tokens"]
+            entry.update({
+                "prefix_hit_tokens": hit_tokens,
+                "prefix_hit_frac": (hit_tokens / lookup_tok
+                                    if lookup_tok else 0.0),
+                "cow_splits": radix.stats["cow_splits"] - stats0["cow_splits"],
+                "evicted_pages": (radix.stats["evicted_pages"]
+                                  - stats0["evicted_pages"]),
+                "shared_pages": shared_peak,
+            })
+        paged_stats_out.append(entry)
     if spec and spec_stats_out is not None:
         spec_stats_out.append({
             "verify_steps": n_iter,

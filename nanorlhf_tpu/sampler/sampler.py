@@ -503,6 +503,7 @@ def generate(
     tracer=None,
     paged_stats_out: list | None = None,
     latency=None,
+    prefix_cache=None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
     prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
@@ -529,7 +530,15 @@ def generate(
     `latency` (an enabled telemetry.LatencyHub): the queued paged path
     records true per-request TTFT and per-sync-chunk inter-token gaps
     into it (hist.py); the monolithic one-jit paths ignore it — their
-    dispatch→ready wall is recorded by the orchestrator instead."""
+    dispatch→ready wall is recorded by the orchestrator instead.
+
+    `prefix_cache` (an enabled serving.RadixCache): the queued paged path
+    admits rows through the cross-request radix prefix cache — matched
+    prompt prefixes install refcount-shared pages with zero prefill FLOPs
+    and only the suffix is prefilled (serving/radix.py). The cache resets
+    per call (KV is tied to params), so within a rollout the win comes
+    from the n>1 fanout and repeated dataset prompts. Ignored by the
+    non-queued paths; incompatible with spec_k > 0."""
     total_rows = prompt_ids.shape[0] * sampling.n
     queued = (sampling.page_size > 0 and sampling.decode_rows > 0
               and sampling.decode_rows < total_rows)
@@ -566,7 +575,7 @@ def generate(
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k,
             spec_stats_out=spec_stats_out, paged_stats_out=paged_stats_out,
-            latency=latency,
+            latency=latency, prefix_cache=prefix_cache,
         )
     if sampling.spec_k > 0:
         if sampling.compaction_segments > 0:

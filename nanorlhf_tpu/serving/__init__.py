@@ -1,0 +1,22 @@
+"""Inference serving plane: cross-request radix prefix cache + gateway.
+
+`radix.py` is the host-side radix tree over ref-counted KV pages (the
+paged pool from sampler/paged/ with alloc/release generalized to
+refcount inc/dec), `engine.py` the continuous-batching serving engine
+over the same jitted decode machinery the rollout scheduler uses, and
+`gateway.py` the stdlib-HTTP streaming token API in front of it.
+docs/SERVING.md is the narrative."""
+
+from nanorlhf_tpu.serving.radix import RadixCache, RefPagePool
+
+__all__ = ["RadixCache", "RefPagePool", "ServingEngine", "ServingGateway"]
+
+
+def __getattr__(name):  # engine/gateway pull in jax+model code — lazy
+    if name == "ServingEngine":
+        from nanorlhf_tpu.serving.engine import ServingEngine
+        return ServingEngine
+    if name == "ServingGateway":
+        from nanorlhf_tpu.serving.gateway import ServingGateway
+        return ServingGateway
+    raise AttributeError(name)

@@ -1,0 +1,537 @@
+"""Serving engine: continuous-batching decode over the radix prefix cache.
+
+The rollout scheduler (`sampler/paged/scheduler.py`) serves a CLOSED
+queue — every prompt is known up front and the call returns when the
+queue drains. This module reshapes the same machinery into an OPEN
+server loop for interactive traffic:
+
+  * A fixed-shape jitted decode chunk over `rows` resident rows, like
+    the scheduler's `_decode_chunk`, but with PER-REQUEST sampling
+    params carried as traced `[R]` arrays (`temperature`, `top_p`,
+    `greedy`, token `budget`) instead of static scalars — one compiled
+    program serves any mix of greedy and sampled requests.
+  * Admission through one `RadixCache` kept alive for the engine's
+    whole lifetime (params are fixed, so cached KV never goes stale):
+    a request's matched prefix installs refcount-shared pages with zero
+    prefill FLOPs and only the suffix runs through `suffix_logits`.
+    Cold admissions take the same path with an empty match — the
+    suffix forward starts at the first real token (`fill = pad_count`),
+    so pad KV is never written (and never read: `key_mask` excludes
+    pad slots).
+  * SLO-aware shed-vs-admit: `submit()` rejects when the pending queue
+    is full or when the LatencyHub's p95 TTFT is over the
+    `slo_ttft_p95` rule's warn threshold (telemetry/health.py) — the
+    same rule the health monitor pages on, so the gateway starts
+    shedding exactly when the alert would fire.
+  * Per-request TTFT (submit → first token ready, blocking on the
+    admission forward) and per-chunk mean inter-token gaps stream into
+    the attached LatencyHub under the PR 13 metric names.
+
+Threading: one background loop thread owns the carry, the block table,
+and all device dispatch. `submit()` only appends to the pending deque
+under `make_condition("serving.engine")`; the one extracted lock edge is
+serving.engine -> telemetry.hist (the shed check reads hub quantiles
+under the condition). Radix plan/insert run OUTSIDE the condition, but
+"serving.engine" is still ranked above "serving.radix" in LOCK_ORDER so
+a future admission that does hold both stays deadlock-free by
+construction.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.analysis.lockorder import make_condition
+from nanorlhf_tpu.core.model import decode_step, init_paged_kv_cache
+from nanorlhf_tpu.ops.masking import guard_temperature
+from nanorlhf_tpu.sampler.paged.pages import blocks_per_row
+from nanorlhf_tpu.sampler.sampler import _nucleus_candidates
+from nanorlhf_tpu.serving.radix import (
+    RadixCache, bucket_len, copy_page, prompt_key, suffix_logits,
+)
+from nanorlhf_tpu.telemetry.health import SLO_RULES
+
+# admission PRNG folds live far from the per-iteration decode stream,
+# mirroring the scheduler's convention
+_ADMIT_BASE = 10_000_000
+
+
+def _serving_sample(key, logits, temperature, top_p, greedy, *, top_k,
+                    approx_top_k):
+    """Per-ROW sampling: `sampler._sample_token` with `temperature` /
+    `top_p` / `greedy` as traced `[R]` arrays so one compiled decode
+    step serves heterogeneous requests. Both branches are computed and
+    selected with `jnp.where(greedy, ...)`; the nucleus keep rule
+    broadcasts `top_p[:, None]` against the `[R, K]` candidate set.
+    Unlike the rollout sampler there is no exact full-vocab escape for
+    `top_p >= 1` — serving always samples in top-k candidate space
+    (`top_p = 1` keeps every candidate), which is the usual serving
+    trade and keeps the row-mixed program shape fixed."""
+    scaled = (logits.astype(jnp.float32)
+              / guard_temperature(temperature)[:, None])
+    top_logits, top_idx, keep = _nucleus_candidates(
+        scaled, top_p[:, None], top_k, approx_top_k)
+    kept = jnp.where(keep, top_logits, -jnp.inf)
+    choice = jax.random.categorical(key, kept, axis=-1)
+    sampled = jnp.take_along_axis(
+        top_idx, choice[..., None], axis=-1)[..., 0]
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("top_k", "approx_top_k"))
+def _first_token(logits, key, temperature, top_p, greedy, *, top_k,
+                 approx_top_k):
+    """Sample one admission's first token from its suffix logits [V]."""
+    return _serving_sample(key, logits[None, :], temperature[None],
+                           top_p[None], greedy[None], top_k=top_k,
+                           approx_top_k=approx_top_k)[0]
+
+
+# carry slots: 0 it · 1 out · 2 caches · 3 key_mask · 4 done · 5 cur_tok
+# · 6 n_gen · 7 prompt_len · 8 temperature · 9 top_p · 10 greedy ·
+# 11 budget · 12 key
+def _engine_decode_body(params, config, s, table, *, Tp, max_new,
+                        page_size, eos_token_id, pad_token_id, lora_scale,
+                        top_k, approx_top_k):
+    (it, out, caches, key_mask, done, cur_tok, n_gen, plen, temp, topp,
+     greedy, budget, key) = s
+    R = cur_tok.shape[0]
+    rows = jnp.arange(R)
+    slot = Tp + n_gen - 1
+    key_mask = key_mask.at[rows, slot].set(True)
+    position = plen + n_gen - 1
+    logits, caches = decode_step(
+        params, config, cur_tok, position, slot, key_mask, caches,
+        lora_scale=lora_scale, page_table=table, page_size=page_size,
+    )
+    tok = _serving_sample(jax.random.fold_in(key, it), logits, temp, topp,
+                          greedy, top_k=top_k, approx_top_k=approx_top_k)
+    tok = jnp.where(done, pad_token_id, tok)
+    live = ~done
+    wpos = jnp.where(live, n_gen, max_new)     # done rows drop their write
+    out = out.at[rows, wpos].set(tok, mode="drop")
+    cur_tok = jnp.where(live, tok, cur_tok)
+    n_gen = n_gen + live.astype(jnp.int32)
+    done = done | (tok == eos_token_id) | (n_gen >= budget)
+    return (it + 1, out, caches, key_mask, done, cur_tok, n_gen, plen,
+            temp, topp, greedy, budget, key)
+
+
+_ENGINE_STATIC = ("config", "Tp", "max_new", "page_size", "sync_every",
+                  "eos_token_id", "pad_token_id", "lora_scale", "top_k",
+                  "approx_top_k")
+
+
+@partial(jax.jit, static_argnames=_ENGINE_STATIC)
+def _engine_chunk(params, config, state, table, **statics):
+    """Up to `sync_every` decode iterations; exits once every row is
+    done, so the iteration counter counts true decode dispatches."""
+    sync_every = statics.pop("sync_every")
+
+    def cond(cs):
+        c, s = cs
+        return (c < sync_every) & ~jnp.all(s[4])
+
+    def body(cs):
+        c, s = cs
+        return c + 1, _engine_decode_body(params, config, s, table,
+                                          **statics)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+@partial(jax.jit, static_argnames=("Tp", "max_new", "eos_token_id",
+                                   "pad_token_id"))
+def _engine_install(state, caches, r, tok0, pmask_row, plen, temp, topp,
+                    greedy, budget, *, Tp, max_new, eos_token_id,
+                    pad_token_id):
+    """Reset carry row `r` for a freshly admitted request (post-suffix-
+    prefill values, per-request sampling params into the [R] arrays)."""
+    s = list(state)
+    T_mask = s[3].shape[1]
+    s[2] = caches
+    s[1] = s[1].at[r].set(
+        jnp.full((max_new,), pad_token_id, jnp.int32).at[0].set(tok0))
+    s[3] = s[3].at[r].set(
+        jnp.zeros((T_mask,), bool).at[:Tp].set(pmask_row))
+    s[4] = s[4].at[r].set((tok0 == eos_token_id) | (budget <= 1))
+    s[5] = s[5].at[r].set(tok0)
+    s[6] = s[6].at[r].set(jnp.int32(1))
+    s[7] = s[7].at[r].set(plen)
+    s[8] = s[8].at[r].set(temp)
+    s[9] = s[9].at[r].set(topp)
+    s[10] = s[10].at[r].set(greedy)
+    s[11] = s[11].at[r].set(budget)
+    return tuple(s)
+
+
+@dataclass
+class ServingRequest:
+    """One in-flight request: the stream side reads `out_q` until the
+    `None` sentinel (the emitted stream INCLUDES the EOS token when one
+    fired)."""
+    request_id: int
+    tokens: np.ndarray            # real token ids, un-padded
+    temperature: float
+    top_p: float
+    greedy: bool
+    max_tokens: int
+    t_submit: float
+    out_q: "queue.Queue" = field(default_factory=queue.Queue)
+    n_emitted: int = 0
+
+
+class ServingEngine:
+    """Open-loop continuous batching over the radix prefix cache.
+
+    `prompt_len` / `max_new_tokens` fix the compiled shapes (prompts are
+    left-padded to `prompt_len`; longer prompts are rejected at submit).
+    `slo_warn_ttft_s=None` reads the warn threshold, quantile, and
+    warmup from the `slo_ttft_p95` rule in telemetry.health.SLO_RULES."""
+
+    def __init__(self, params, config, *, eos_token_id, pad_token_id,
+                 page_size=16, prompt_len=32, max_new_tokens=32, rows=2,
+                 headroom=1.0, sync_every=4, max_queue=64, latency=None,
+                 lora_scale=1.0, top_k=64, approx_top_k=True, seed=0,
+                 slo_warn_ttft_s: Optional[float] = None):
+        self.params = params
+        self.config = config
+        self.eos_token_id = int(eos_token_id)
+        self.pad_token_id = int(pad_token_id)
+        self.page_size = int(page_size)
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.rows = int(rows)
+        self.sync_every = int(sync_every)
+        self.max_queue = int(max_queue)
+        self.lora_scale = float(lora_scale)
+        self.top_k = int(top_k)
+        self.approx_top_k = bool(approx_top_k)
+
+        rule = next(r for r in SLO_RULES if r.name == "slo_ttft_p95")
+        self._slo_metric = rule.metric
+        self._slo_q = rule.quantile
+        self._slo_warmup = rule.warmup
+        self._slo_warn = (rule.warn if slo_warn_ttft_s is None
+                          else float(slo_warn_ttft_s))
+
+        self._hub = latency if (latency is not None
+                                and latency.enabled) else None
+
+        self.T_max = self.prompt_len + self.max_new_tokens
+        self.nb = blocks_per_row(self.T_max, self.page_size)
+        self._radix = RadixCache(headroom=headroom)
+        self.num_pages = (self.rows * self.nb
+                          + self._radix.extra_pages(self.rows, self.nb))
+        self._radix.reset(num_pages=self.num_pages,
+                          page_size=self.page_size)
+
+        R, Tp, mx = self.rows, self.prompt_len, self.max_new_tokens
+        caches0 = init_paged_kv_cache(
+            config, self.num_pages, self.page_size,
+            params["embed_tokens"].dtype)
+        self._state = (jnp.int32(1),
+                       jnp.full((R, mx), self.pad_token_id, jnp.int32),
+                       caches0,
+                       jnp.zeros((R, self.T_max), bool),
+                       jnp.ones((R,), bool),
+                       jnp.zeros((R,), jnp.int32),
+                       jnp.ones((R,), jnp.int32),
+                       jnp.zeros((R,), jnp.int32),
+                       jnp.ones((R,), jnp.float32),
+                       jnp.ones((R,), jnp.float32),
+                       jnp.zeros((R,), bool),
+                       jnp.ones((R,), jnp.int32),
+                       jax.random.PRNGKey(seed))
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._table = np.full((R, self.nb), self.num_pages, np.int32)
+        self._owner: list = [None] * R           # row -> ServingRequest
+        self._statics = dict(
+            Tp=Tp, max_new=mx, page_size=self.page_size,
+            sync_every=self.sync_every, eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id, lora_scale=self.lora_scale,
+            top_k=self.top_k, approx_top_k=self.approx_top_k,
+        )
+
+        self._cond = make_condition("serving.engine")
+        self._pending: deque = deque()
+        self._n_active = 0
+        self._running = True
+        self._ids = itertools.count()
+        self._counters = {"requests": 0, "admitted": 0, "shed": 0,
+                          "completed": 0}
+        self._dispatch_tokens = 0
+        self._it_prev = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- #
+    # client side
+    # ------------------------------------------------------------- #
+
+    def submit(self, tokens, *, temperature=1.0, top_p=1.0, greedy=False,
+               max_tokens=None):
+        """Admission-controlled enqueue. Returns `(request, None)` or
+        `(None, shed_reason)` — `"queue_full"` when the pending bound is
+        hit, `"slo_ttft_p95"` when the hub's p95 TTFT is over the SLO
+        warn threshold (past its warmup count)."""
+        toks = np.asarray(tokens, np.int32).ravel()
+        if toks.size < 1 or toks.size > self.prompt_len:
+            raise ValueError(
+                f"prompt length {toks.size} outside [1, {self.prompt_len}]"
+                " — the engine's compiled prompt shape is fixed")
+        mx = self.max_new_tokens if max_tokens is None else int(max_tokens)
+        mx = max(1, min(mx, self.max_new_tokens))
+        with self._cond:
+            self._counters["requests"] += 1
+            reason = self._shed_reason_locked()
+            if reason is not None:
+                self._counters["shed"] += 1
+                return None, reason
+            req = ServingRequest(
+                request_id=next(self._ids), tokens=toks,
+                temperature=float(temperature), top_p=float(top_p),
+                greedy=bool(greedy), max_tokens=mx,
+                t_submit=time.perf_counter())
+            self._pending.append(req)
+            self._cond.notify_all()
+        return req, None
+
+    def _shed_reason_locked(self) -> Optional[str]:
+        if not self._running:
+            return "closed"
+        if len(self._pending) >= self.max_queue:
+            return "queue_full"
+        if (self._hub is not None
+                and self._hub.count(self._slo_metric) >= self._slo_warmup
+                and self._hub.quantile(self._slo_metric,
+                                       self._slo_q) > self._slo_warn):
+            return "slo_ttft_p95"
+        return None
+
+    def stream(self, req: ServingRequest, timeout: float = 120.0):
+        """Yield the request's tokens as they land; ends at the `None`
+        sentinel (or on `timeout` seconds of silence)."""
+        while True:
+            try:
+                tok = req.out_q.get(timeout=timeout)
+            except queue.Empty:
+                return
+            if tok is None:
+                return
+            yield tok
+
+    # ------------------------------------------------------------- #
+    # engine loop (single background thread owns all device state)
+    # ------------------------------------------------------------- #
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (self._running and not self._pending
+                       and self._n_active == 0):
+                    self._cond.wait(0.05)
+                if (not self._running and self._n_active == 0
+                        and not self._pending):
+                    break
+                admits = []
+                free_rows = [r for r in range(self.rows)
+                             if self._owner[r] is None]
+                while free_rows and self._pending:
+                    admits.append((free_rows.pop(0),
+                                   self._pending.popleft()))
+                self._n_active += len(admits)
+            for r, req in admits:
+                self._admit(r, req)
+            if all(o is None for o in self._owner):
+                continue
+            t0 = time.perf_counter()
+            self._state = _engine_chunk(
+                self.params, self.config, self._state,
+                jnp.asarray(self._table), **self._statics)
+            self._deliver(t0)
+
+    def _admit(self, r: int, req: ServingRequest):
+        Tp, P = self.prompt_len, self.page_size
+        n = int(req.tokens.size)
+        pad_count = Tp - n
+        toks_p = np.full(Tp, self.pad_token_id, np.int32)
+        toks_p[pad_count:] = req.tokens
+        mask = np.zeros(Tp, bool)
+        mask[pad_count:] = True
+        kelems = prompt_key(toks_p, mask)
+        try:
+            plan = self._radix.plan(kelems, pad_count=pad_count,
+                                    n_blocks=self.nb, prompt_len=Tp)
+        except RuntimeError:
+            # pool sizing makes this unreachable (rows*nb live refs max,
+            # the rest evictable) — shed rather than crash if it fires
+            with self._cond:
+                self._counters["shed"] += 1
+                self._n_active -= 1
+            req.out_q.put(None)
+            return
+        self._table[r] = plan.row_pages
+        caches = self._state[2]
+        if plan.cow_src is not None:
+            caches = copy_page(caches, plan.cow_src, plan.cow_dst)
+        # unified suffix forward: a cold admission is just an empty match
+        # — fill starts at the first REAL token, so pad KV never exists
+        start = plan.m if plan.m > 0 else pad_count
+        s_real = Tp - start
+        Sb = bucket_len(s_real, self.T_max - start)
+        suffix = np.zeros((1, Sb), np.int32)
+        suffix[0, :s_real] = toks_p[start:]
+        pos = (start - pad_count) + np.arange(Sb, dtype=np.int32)[None]
+        km = np.zeros((1, self.T_max), bool)
+        km[0, pad_count:start] = True
+        logits, caches = suffix_logits(
+            self.params, self.config, jnp.asarray(suffix),
+            jnp.asarray(pos), jnp.asarray([start], jnp.int32),
+            jnp.int32(s_real - 1), jnp.asarray(km), caches,
+            jnp.asarray(plan.row_pages), page_size=P,
+            lora_scale=self.lora_scale)
+        self._dispatch_tokens += Sb
+        tok0 = _first_token(
+            logits,
+            jax.random.fold_in(self._key, _ADMIT_BASE + req.request_id),
+            jnp.float32(req.temperature), jnp.float32(req.top_p),
+            jnp.asarray(req.greedy), top_k=self.top_k,
+            approx_top_k=self.approx_top_k)
+        self._state = _engine_install(
+            self._state, caches, r, tok0, jnp.asarray(mask),
+            jnp.int32(n), jnp.float32(req.temperature),
+            jnp.float32(req.top_p), jnp.asarray(req.greedy),
+            jnp.int32(req.max_tokens), Tp=Tp, max_new=self.max_new_tokens,
+            eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id)
+        self._radix.insert(kelems, plan.row_pages, Tp)
+        self._owner[r] = req
+        jax.block_until_ready(tok0)
+        if self._hub is not None:
+            self._hub.record("latency/ttft_s",
+                             time.perf_counter() - req.t_submit)
+        with self._cond:
+            self._counters["admitted"] += 1
+        req.out_q.put(int(tok0))
+        req.n_emitted = 1
+
+    def _deliver(self, t_chunk0: float):
+        state = self._state
+        done_h = np.asarray(state[4])
+        out_h = np.asarray(state[1])
+        n_gen_h = np.asarray(state[6])
+        it_now = int(state[0]) - 1
+        if self._hub is not None and it_now > self._it_prev:
+            self._hub.record("latency/intertoken_s",
+                             (time.perf_counter() - t_chunk0)
+                             / (it_now - self._it_prev))
+        self._it_prev = it_now
+        for r in range(self.rows):
+            req = self._owner[r]
+            if req is None:
+                continue
+            n = int(n_gen_h[r])
+            for tok in out_h[r, req.n_emitted:n]:
+                req.out_q.put(int(tok))
+            req.n_emitted = n
+            if done_h[r]:
+                req.out_q.put(None)
+                self._radix.release(self._table[r])
+                self._table[r] = self.num_pages
+                self._owner[r] = None
+                with self._cond:
+                    self._counters["completed"] += 1
+                    self._n_active -= 1
+                    self._cond.notify_all()
+
+    # ------------------------------------------------------------- #
+    # observability
+    # ------------------------------------------------------------- #
+
+    def metrics(self) -> dict:
+        """Flat scalar row for /metrics — the serving/* registry keys
+        (METRICS.md) plus the pool's live shared-page gauge."""
+        with self._cond:
+            c = dict(self._counters)
+            pending = len(self._pending)
+            active = self._n_active
+        snap = self._radix.snapshot()
+        return {
+            "serving/requests": c["requests"],
+            "serving/admitted": c["admitted"],
+            "serving/shed": c["shed"],
+            "serving/completed": c["completed"],
+            "serving/pending": pending,
+            "serving/active": active,
+            "serving/prefix_hit_tokens": snap["hit_tokens"],
+            "serving/prefix_hit_frac": snap["hit_frac"],
+            "serving/cow_splits": snap["cow_splits"],
+            "serving/evicted_pages": snap["evicted_pages"],
+            "serving/prefill_token_dispatch": self._dispatch_tokens,
+            "pages/shared": snap["shared_pages"],
+        }
+
+    def snapshot(self) -> dict:
+        """JSON-able /statusz section: engine shape + live occupancy +
+        the radix tree's own snapshot under `prefix_cache`."""
+        with self._cond:
+            c = dict(self._counters)
+            pending = len(self._pending)
+            active = self._n_active
+        return {
+            "rows": self.rows,
+            "active": active,
+            "pending": pending,
+            "prompt_len": self.prompt_len,
+            "max_new_tokens": self.max_new_tokens,
+            "page_size": self.page_size,
+            "num_pages": self.num_pages,
+            "counters": c,
+            "prefill_token_dispatch": self._dispatch_tokens,
+            "slo": {"rule": "slo_ttft_p95", "warn_s": self._slo_warn,
+                    "quantile": self._slo_q, "warmup": self._slo_warmup},
+            "prefix_cache": self._radix.snapshot(),
+        }
+
+    @property
+    def radix(self) -> RadixCache:
+        return self._radix
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop admitting, drain active rows, shed the pending queue
+        (each pending request's stream ends at the sentinel), join the
+        loop thread. Idempotent."""
+        with self._cond:
+            if not self._running and self._thread is None:
+                return
+            self._running = False
+            pending = list(self._pending)
+            self._pending.clear()
+            self._counters["shed"] += len(pending)
+            self._cond.notify_all()
+        for req in pending:
+            req.out_q.put(None)
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

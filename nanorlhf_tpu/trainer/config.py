@@ -318,6 +318,18 @@ class RLConfig:
     # rollout/page_* metrics + /statusz "pages" + lineage lease events.
     # 0 (or >= the rollout batch) = monolithic paged loop.
     rollout_decode_rows: int = 0
+    # continuous batching only (rollout_page_size > 0 AND
+    # rollout_decode_rows > 0). True: admissions route through the
+    # cross-request radix prefix cache (serving/radix.py,
+    # docs/SERVING.md) — repeated prompt prefixes across the rollout
+    # queue (the n>1 fanout, dataset-level repeats) install
+    # refcount-shared KV pages with zero prefill FLOPs and only the
+    # suffix is prefilled. Greedy streams stay bit-identical to the
+    # uncached path (test-pinned); sampled streams are equal in
+    # distribution only. Incompatible with rollout_spec_k > 0. Default
+    # off: the cache resets every generate call (KV is params-tied), so
+    # it only pays when rollout prompts overlap.
+    rollout_prefix_cache: bool = False
 
     # ---- resilience (resilience/, docs/RESILIENCE.md) ----
     # fault-injection spec ("point:at=N,..."); None falls back to the

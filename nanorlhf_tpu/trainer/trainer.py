@@ -602,6 +602,27 @@ class RLTrainer:
         # instrumentation site stays inline (bench's detail.latency A/B
         # is the overhead gate).
         self.latency = LatencyHub(enabled=config.latency)
+        # cross-request radix prefix cache (rollout_prefix_cache, serving/
+        # radix.py, docs/SERVING.md): the queued rollout path admits rows
+        # through it — one long-lived object so the cumulative stats feed
+        # pages/shared + /statusz "prefix_cache"; the scheduler resets its
+        # pool/tree every generate call (cached KV is params-tied).
+        self.prefix_cache = None
+        if config.rollout_prefix_cache:
+            if config.rollout_spec_k > 0:
+                raise ValueError(
+                    "rollout_prefix_cache is incompatible with "
+                    "rollout_spec_k > 0 (the radix admission path does "
+                    "not model the speculative carry) — pick one")
+            if not (config.rollout_page_size > 0
+                    and config.rollout_decode_rows > 0):
+                raise ValueError(
+                    "rollout_prefix_cache requires continuous batching: "
+                    "set rollout_page_size > 0 and rollout_decode_rows "
+                    "> 0 (the monolithic paths have no admission point "
+                    "to cache across)")
+            from nanorlhf_tpu.serving.radix import RadixCache
+            self.prefix_cache = RadixCache()
         # run-health plane (telemetry/health.py, docs/OBSERVABILITY.md §5):
         # every metrics row folds through streaming aggregates + anomaly
         # rules; CRIT dumps a reason="health" blackbox through the tracer
@@ -926,7 +947,7 @@ class RLTrainer:
         rollout_page_size is off."""
         if paged_stats is None:
             return {}
-        return {
+        out = {
             "rollout/page_utilization": float(
                 np.asarray(paged_stats["page_utilization"])),
             "rollout/pages_recycled": float(
@@ -934,6 +955,13 @@ class RLTrainer:
             "rollout/admitted_midloop": float(
                 np.asarray(paged_stats["admitted_midloop"])),
         }
+        if "prefix_hit_frac" in paged_stats:
+            # radix prefix cache active (rollout_prefix_cache): suffix-only
+            # admission prefill + refcount-shared pages (docs/SERVING.md)
+            out["rollout/prefix_hit_frac"] = float(
+                paged_stats["prefix_hit_frac"])
+            out["pages/shared"] = float(paged_stats["shared_pages"])
+        return out
 
     # ------------------------------------------------------------------ #
     # telemetry: perf/MFU accounting (telemetry/, docs/OBSERVABILITY.md)
@@ -1034,6 +1062,11 @@ class RLTrainer:
             # occupancy / recycling / mid-loop admission snapshot; None when
             # the lever is off
             "pages": getattr(self, "_pages_status", None),
+            # radix prefix cache (rollout_prefix_cache): tree size, pool
+            # occupancy, cumulative hit/COW/eviction counters
+            # (serving/radix.py snapshot); None when the lever is off
+            "prefix_cache": (self.prefix_cache.snapshot()
+                             if self.prefix_cache is not None else None),
         }
         if orch is not None and hasattr(orch, "status_snapshot"):
             out.update(orch.status_snapshot())
@@ -1582,6 +1615,7 @@ class RLTrainer:
                 lora_scale=self.lora_scale, batch_sharding=bs,
                 spec_stats_out=spec_stats, tracer=self.tracer,
                 paged_stats_out=paged_stats, latency=self.latency,
+                prefix_cache=self.prefix_cache,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:

@@ -1,0 +1,469 @@
+"""Serving plane: radix prefix cache + engine + gateway (serving/, ISSUE 14).
+
+Pins the acceptance contract: radix insert/match/split on non-page-
+aligned boundaries, COW divergence mid-page, LRU eviction never freeing
+a page a live holder references, the double-release invariants of both
+allocators (refcounted pool AND the jitted free stack), suffix-prefill
+logits matching full prefill bit-for-bit on the CPU mesh, greedy queued
+generation bit-identical with `prefix_cache` on vs off while dispatching
+STRICTLY fewer prefill tokens, and the gateway end-to-end (streaming +
+non-streaming /generate, Prometheus-valid /metrics, shed → 429,
+loopback-only bind). CI runs this file as the `serving-smoke` tier-1
+step under NANORLHF_LOCK_CHECK=1, so every engine/radix lock acquisition
+is order-checked live.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.core.model import init_paged_kv_cache, prefill
+from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.sampler.paged.pages import (
+    init_page_state, release_row,
+)
+from nanorlhf_tpu.serving.radix import (
+    AdmissionPlan, RadixCache, RefPagePool, bucket_len, prompt_key,
+    suffix_logits,
+)
+
+EOS, PAD = 3, 0
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(7), jnp.float32)
+    return config, params
+
+
+def _left_pad(rows, T, pad=PAD):
+    ids = np.full((len(rows), T), pad, np.int32)
+    for i, r in enumerate(rows):
+        ids[i, T - len(r):] = r
+    ids = jnp.asarray(ids)
+    return ids, ids != pad
+
+
+def _key_for(toks, T):
+    """Radix key of a left-padded row built from real tokens `toks`."""
+    row = np.full(T, PAD, np.int32)
+    row[T - len(toks):] = toks
+    mask = np.zeros(T, bool)
+    mask[T - len(toks):] = True
+    return prompt_key(row, mask), T - len(toks)
+
+
+def _cache(num_pages=32, page_size=4):
+    rc = RadixCache()
+    rc.reset(num_pages=num_pages, page_size=page_size)
+    return rc
+
+
+# --------------------------------------------------------------------- #
+# RefPagePool: refcount + double-release invariants
+# --------------------------------------------------------------------- #
+
+def test_pool_refcount_lifecycle():
+    pool = RefPagePool(4)
+    p = pool.alloc()
+    assert pool.ref[p] == 1 and pool.free_count == 3
+    pool.inc(p)
+    assert pool.ref[p] == 2 and pool.shared_count() == 1
+    assert not pool.unref(p)          # still held
+    assert pool.unref(p)              # freed at zero
+    assert pool.free_count == 4 and pool.shared_count() == 0
+
+
+def test_pool_double_unref_is_hard_error():
+    pool = RefPagePool(2)
+    p = pool.alloc()
+    pool.unref(p)
+    with pytest.raises(AssertionError):
+        pool.unref(p)                 # past zero: invariant violation
+    with pytest.raises(AssertionError):
+        pool.inc(p)                   # ref of a free page likewise
+
+
+def test_radix_release_idempotent_at_row_level():
+    rc = _cache(num_pages=8, page_size=4)
+    key, pad = _key_for([5, 6, 7, 8, 9, 10], 8)
+    plan = rc.plan(key, pad_count=pad, n_blocks=2, prompt_len=8)
+    rc.insert(key, plan.row_pages, 8)
+    row = plan.row_pages.copy()
+    rc.release(row)
+    row[:] = rc.pool.num_pages        # scheduler's sentinel reset
+    assert rc.release(row) == 0       # second release: no-op, no assert
+
+
+def test_jitted_release_row_double_release_noop():
+    st = init_page_state(8, 2, 2)
+    from nanorlhf_tpu.sampler.paged.pages import alloc_row
+    st, ok = jax.jit(alloc_row)(st, 0, 2)
+    assert bool(ok)
+    rel = jax.jit(release_row)
+    st, m1 = rel(st, 0)
+    st, m2 = rel(st, 0)               # row is sentinel now
+    assert int(m1) == 2 and int(m2) == 0
+    assert int(st.top) == 8
+
+
+# --------------------------------------------------------------------- #
+# radix tree: match / split / COW / eviction (host-only, no model)
+# --------------------------------------------------------------------- #
+
+def test_radix_match_and_split_non_page_aligned():
+    rc = _cache(page_size=4)
+    T = 12
+    k1, pad1 = _key_for([5, 6, 7, 8, 9, 10], T)     # pad=6: non-aligned
+    p1 = rc.plan(k1, pad_count=pad1, n_blocks=3, prompt_len=T)
+    assert p1.m == 0 and p1.shared == 0             # cold
+    rc.insert(k1, p1.row_pages, T)
+
+    # same first 5 real tokens, diverging at the last — the match ends
+    # at key position 11 (pad 6 + 5 real), inside page 2 (slots 8..11):
+    # a mid-edge split at a non-page-aligned boundary
+    k2, pad2 = _key_for([5, 6, 7, 8, 9, 11], T)
+    p2 = rc.plan(k2, pad_count=pad2, n_blocks=3, prompt_len=T)
+    assert p2.m == 11 and p2.hit_tokens == 5
+    assert p2.shared == 2                           # pages 0,1 full-shared
+    assert p2.cow_src is not None and p2.cow_dst == int(p2.row_pages[2])
+    assert p2.cow_src != p2.cow_dst                 # fresh private copy
+    rc.insert(k2, p2.row_pages, T)
+
+    # identical prompt: full-prefix hit capped at prompt_len - 1 (one
+    # suffix token must remain to produce admission logits)
+    k3, pad3 = _key_for([5, 6, 7, 8, 9, 10], T)
+    p3 = rc.plan(k3, pad_count=pad3, n_blocks=3, prompt_len=T)
+    assert p3.m == T - 1 and p3.hit_tokens == 5
+    # the tree survived the split: nodes for the shared prefix + two
+    # divergent tails
+    snap = rc.snapshot()
+    assert snap["nodes"] >= 3
+    assert snap["shared_pages"] > 0
+
+
+def test_radix_pad_layout_mismatch_shares_no_real_tokens():
+    rc = _cache(page_size=4)
+    T = 12
+    k1, pad1 = _key_for([5, 6, 7, 8, 9, 10], T)     # pad=6
+    p1 = rc.plan(k1, pad_count=pad1, n_blocks=3, prompt_len=T)
+    rc.insert(k1, p1.row_pages, T)
+    # same real tokens, one fewer pad: the slot layouts differ, so the
+    # only common key prefix is the PAD run (5 elements). The plan may
+    # share the pads-only page (free, never read) but must count zero
+    # hit tokens and skip the pointless COW copy of a pad straddler
+    k2, pad2 = _key_for([5, 6, 7, 8, 9, 10, 12], T)  # pad=5
+    p2 = rc.plan(k2, pad_count=pad2, n_blocks=3, prompt_len=T)
+    assert p2.m == pad2                              # pads only
+    assert p2.hit_tokens == 0
+    assert p2.cow_src is None                        # no pad-page COW
+    # every REAL token still prefills (the suffix spans them all)
+    assert T - p2.m == len([5, 6, 7, 8, 9, 10, 12])
+
+
+def test_radix_match_inside_pad_region_degrades_to_cold():
+    rc = _cache(page_size=4)
+    T = 12
+    k1, pad1 = _key_for([5, 6, 7, 8, 9, 10, 11, 12], T)   # pad=4
+    p1 = rc.plan(k1, pad_count=pad1, n_blocks=3, prompt_len=T)
+    rc.insert(k1, p1.row_pages, T)
+    # a much shorter prompt shares only 4 pad elements of its 10-pad
+    # run: the match dies STRICTLY inside the new row's pad region
+    # (m_raw = 4 < pad_count = 10) and must degrade to cold — a suffix
+    # starting inside the pads would break the decode_verify parity
+    k2, pad2 = _key_for([7, 8], T)
+    assert pad2 == 10
+    p2 = rc.plan(k2, pad_count=pad2, n_blocks=3, prompt_len=T)
+    assert p2.m == 0 and p2.hit_tokens == 0 and p2.cow_src is None
+
+
+def test_lru_eviction_never_frees_referenced_page():
+    # pool sized so the third admission must evict; full-length prompts
+    # (pad_count = 0) so no pad page is shared across the rows
+    rc = _cache(num_pages=4, page_size=4)
+    T = 8
+    ka, pada = _key_for([21, 22, 23, 24, 25, 26, 27, 28], T)
+    pa = rc.plan(ka, pad_count=pada, n_blocks=2, prompt_len=T)
+    rc.insert(ka, pa.row_pages, T)                  # row A LIVE + cached
+    kb, padb = _key_for([31, 32, 33, 34, 35, 36, 37, 38], T)
+    pb = rc.plan(kb, pad_count=padb, n_blocks=2, prompt_len=T)
+    rc.insert(kb, pb.row_pages, T)
+    rc.release(pb.row_pages)                        # row B released: its
+    # subtree is refcount-1 (tree-only) → the eviction candidate
+    kc, padc = _key_for([41, 42, 43, 44, 45, 46, 47, 48], T)
+    pc = rc.plan(kc, pad_count=padc, n_blocks=2, prompt_len=T)
+    assert pc.evicted == 2                          # B's pages, not A's
+    # A's pages still ref'd by both the tree and the live row
+    for pid in pa.row_pages:
+        assert rc.pool.ref[int(pid)] == 2
+    # and A's prefix still matches — it was never evicted (C's row must
+    # release first so its subtree becomes the next eviction candidate)
+    rc.release(pc.row_pages)
+    pa2 = rc.plan(ka, pad_count=pada, n_blocks=2, prompt_len=T)
+    assert pa2.m == T - 1
+    assert pa2.shared == 1                          # A's full page 0
+
+
+def test_plan_raises_when_nothing_evictable():
+    rc = _cache(num_pages=2, page_size=4)
+    T = 8
+    ka, pada = _key_for([21, 22, 23, 24], T)
+    rc.insert(ka, rc.plan(ka, pad_count=pada, n_blocks=2,
+                          prompt_len=T).row_pages, T)
+    kb, padb = _key_for([31, 32, 33, 34], T)
+    with pytest.raises(RuntimeError, match="radix pool exhausted"):
+        rc.plan(kb, pad_count=padb, n_blocks=2, prompt_len=T)
+
+
+def test_bucket_len_powers_of_two_clamped():
+    assert bucket_len(1, 16) == 1
+    assert bucket_len(3, 16) == 4
+    assert bucket_len(5, 6) == 6      # clamp beats the power of two
+    assert bucket_len(7, 7) == 7
+
+
+# --------------------------------------------------------------------- #
+# suffix prefill ≡ full prefill (the bit-parity the cache rests on)
+# --------------------------------------------------------------------- #
+
+def test_suffix_logits_match_full_prefill(tiny):
+    config, params = tiny
+    Tp, P, max_new = 8, 4, 4
+    T_max = Tp + max_new
+    nb = -(-T_max // P)
+    toks = [5, 6, 7, 8, 9, 10]
+    ids, mask = _left_pad([toks], Tp)
+    pad_count = Tp - len(toks)
+
+    # oracle: single-row full prefill through an identity block table
+    caches_a = init_paged_kv_cache(config, nb, P, jnp.float32)
+    table = jnp.arange(nb, dtype=jnp.int32)
+    logits_a, _ = prefill(params, config, ids, mask, caches_a,
+                          page_table=table[None, :], page_size=P,
+                          logical_len=T_max)
+
+    # suffix path: prefill [pad, m) via the oracle's own forward, then
+    # decode_verify over [m, Tp) — non-page-aligned split (m = 5)
+    m = 5
+    caches_b = init_paged_kv_cache(config, nb, P, jnp.float32)
+    ids_pref = jnp.asarray(np.where(np.arange(Tp) < m,
+                                    np.asarray(ids)[0], PAD)[None, :])
+    mask_pref = jnp.asarray((np.arange(Tp) < m)
+                            & np.asarray(mask)[0])[None, :]
+    _, caches_b = prefill(params, config, ids_pref, mask_pref, caches_b,
+                          page_table=table[None, :], page_size=P,
+                          logical_len=T_max)
+    s_real = Tp - m
+    Sb = bucket_len(s_real, T_max - m)
+    suffix = np.zeros((1, Sb), np.int32)
+    suffix[0, :s_real] = toks[m - pad_count:]
+    pos = (m - pad_count) + np.arange(Sb, dtype=np.int32)[None]
+    km = np.zeros((1, T_max), bool)
+    km[0, pad_count:m] = True
+    logits_b, _ = suffix_logits(
+        params, config, jnp.asarray(suffix), jnp.asarray(pos),
+        jnp.asarray([m], jnp.int32), jnp.int32(s_real - 1),
+        jnp.asarray(km), caches_b, table, page_size=P, lora_scale=1.0)
+    np.testing.assert_array_equal(np.asarray(logits_a[0]),
+                                  np.asarray(logits_b))
+
+
+# --------------------------------------------------------------------- #
+# queued generation: greedy bit-parity + strictly fewer prefill tokens
+# --------------------------------------------------------------------- #
+
+OVERLAP_PROMPTS = [
+    [5, 6, 7, 8, 9, 10],        # base
+    [5, 6, 7, 8, 9, 11],        # mid-page divergence (COW)
+    [5, 6, 7, 8, 9, 10],        # exact repeat (full hit)
+    [20, 21],                   # cold, different pad layout
+    [5, 6, 7, 8, 9, 10, 12],    # longer: no match (pad layout differs)
+    [20, 21],                   # repeat of the cold one
+]
+
+
+def _queued(tiny, prefix_cache, stats, greedy=True, key=0):
+    config, params = tiny
+    ids, mask = _left_pad(OVERLAP_PROMPTS, 12)
+    sp = SamplingParams(max_tokens=8, greedy=greedy, page_size=4,
+                        decode_rows=2, temperature=1.0, top_p=0.9)
+    return generate(params, config, ids, mask, jax.random.PRNGKey(key),
+                    sp, eos_token_id=EOS, pad_token_id=PAD,
+                    paged_stats_out=stats, prefix_cache=prefix_cache)
+
+
+def test_greedy_bit_parity_and_fewer_prefill_dispatch(tiny):
+    stats_off, stats_on = [], []
+    out_off = _queued(tiny, None, stats_off)
+    out_on = _queued(tiny, RadixCache(), stats_on)
+    np.testing.assert_array_equal(np.asarray(out_off), np.asarray(out_on))
+    off, on = stats_off[0], stats_on[0]
+    assert on["prefill_token_dispatch"] < off["prefill_token_dispatch"]
+    assert on["prefix_hit_frac"] > 0.3
+    assert on["cow_splits"] >= 1
+    assert on["shared_pages"] > 0
+    assert "prefix_hit_frac" not in off       # radix-only stat keys
+
+
+def test_prefix_cache_spec_k_incompatible(tiny):
+    config, params = tiny
+    ids, mask = _left_pad(OVERLAP_PROMPTS[:4], 12)
+    sp = SamplingParams(max_tokens=4, greedy=True, page_size=4,
+                        decode_rows=2, spec_k=2)
+    with pytest.raises(ValueError, match="prefix_cache"):
+        generate(params, config, ids, mask, jax.random.PRNGKey(0), sp,
+                 eos_token_id=EOS, pad_token_id=PAD,
+                 prefix_cache=RadixCache())
+
+
+# --------------------------------------------------------------------- #
+# engine + gateway end-to-end
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def served(tiny):
+    from nanorlhf_tpu.serving.engine import ServingEngine
+    from nanorlhf_tpu.serving.gateway import ServingGateway
+    from nanorlhf_tpu.telemetry.hist import LatencyHub
+
+    config, params = tiny
+    hub = LatencyHub(enabled=True)
+    eng = ServingEngine(params, config, eos_token_id=EOS,
+                        pad_token_id=PAD, page_size=4, prompt_len=12,
+                        max_new_tokens=8, rows=2, latency=hub, seed=0)
+    gw = ServingGateway(eng, port=-1)
+    yield eng, gw, f"http://127.0.0.1:{gw.port}"
+    gw.close()
+    eng.close()
+
+
+def _post(base, payload, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_gateway_generate_and_prefix_reuse(served):
+    eng, _, base = served
+    r1 = json.loads(_post(base, {"tokens": [5, 6, 7, 8, 9, 10],
+                                 "greedy": True}).read())
+    assert len(r1["tokens"]) >= 1
+    # identical greedy request: bit-identical stream, now prefix-cached
+    r2 = json.loads(_post(base, {"tokens": [5, 6, 7, 8, 9, 10],
+                                 "greedy": True}).read())
+    assert r2["tokens"] == r1["tokens"]
+    assert eng.metrics()["serving/prefix_hit_tokens"] > 0
+
+    # streaming: NDJSON token lines then the done record, same tokens
+    resp = _post(base, {"tokens": [5, 6, 7, 8, 9, 10], "greedy": True,
+                        "stream": True})
+    assert "application/x-ndjson" in resp.headers["Content-Type"]
+    lines = [json.loads(ln) for ln in resp.read().decode().splitlines()]
+    assert lines[-1]["done"] is True
+    assert [ln["token"] for ln in lines[:-1]] == r1["tokens"]
+
+
+def test_gateway_metrics_prometheus_valid(served):
+    from nanorlhf_tpu.telemetry.exporter import validate_prometheus_text
+    _, _, base = served
+    text = urllib.request.urlopen(base + "/metrics",
+                                  timeout=30).read().decode()
+    assert validate_prometheus_text(text) == []
+    assert "nanorlhf_serving_requests" in text
+    assert "nanorlhf_pages_shared" in text
+    assert "nanorlhf_latency_ttft_s_bucket" in text   # hub histograms ride
+
+    statusz = json.loads(urllib.request.urlopen(
+        base + "/statusz", timeout=30).read())
+    assert statusz["prefix_cache"]["nodes"] >= 1      # inspectable tree
+    assert statusz["slo"]["rule"] == "slo_ttft_p95"
+    assert urllib.request.urlopen(base + "/healthz",
+                                  timeout=30).status == 200
+
+
+def test_gateway_sheds_on_slo_and_answers_429(served):
+    eng, _, base = served
+    hub = eng._hub
+    # push the hub's p95 TTFT far over the warn threshold (past warmup)
+    for _ in range(eng._slo_warmup + 4):
+        hub.record("latency/ttft_s", eng._slo_warn * 10)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(base, {"tokens": [1, 2, 3]})
+    assert err.value.code == 429
+    assert json.loads(err.value.read())["reason"] == "slo_ttft_p95"
+    shed_before = eng.metrics()["serving/shed"]
+    assert shed_before >= 1
+    # restore: overwrite the histogram with fast observations is not
+    # possible (streaming), so later tests must not submit — this is the
+    # module's final gateway test by ordering; still verify the engine
+    # rejects directly too
+    req, reason = eng.submit([1, 2, 3])
+    assert req is None and reason == "slo_ttft_p95"
+
+
+def test_gateway_rejects_bad_request_and_nonloopback():
+    from nanorlhf_tpu.serving.gateway import ServingGateway
+    with pytest.raises(ValueError, match="loopback"):
+        ServingGateway(object(), port=-1, host="0.0.0.0")
+
+
+def test_engine_prompt_length_validation(served):
+    eng, _, _ = served
+    with pytest.raises(ValueError, match="prompt length"):
+        eng.submit(list(range(eng.prompt_len + 1)))
+
+
+# --------------------------------------------------------------------- #
+# trainer wiring: knob validation + GRPO smoke with the cache on
+# --------------------------------------------------------------------- #
+
+def test_trainer_knob_validation(tmp_path):
+    from nanorlhf_tpu.trainer import AlgoName
+    from tests.test_trainer_smoke import make_trainer
+
+    # default off
+    from nanorlhf_tpu.trainer.config import RLConfig
+    assert RLConfig().rollout_prefix_cache is False
+    # requires continuous batching
+    with pytest.raises(ValueError, match="continuous batching"):
+        make_trainer(AlgoName.GRPO, tmp_path, rollout_prefix_cache=True)
+    # incompatible with speculative decode
+    with pytest.raises(ValueError, match="rollout_spec_k"):
+        make_trainer(AlgoName.GRPO, tmp_path / "b",
+                     rollout_prefix_cache=True, rollout_page_size=4,
+                     rollout_decode_rows=2, rollout_spec_k=2)
+
+
+def test_grpo_update_with_prefix_cache(tmp_path):
+    """One GRPO update with rollout_prefix_cache on: the rollout path
+    plans/inserts/releases through the radix cache without disturbing
+    training, and the prefix-hit + pages/shared metrics land (sample_n=2
+    guarantees cross-request overlap — each prompt admits twice)."""
+    import json as _json
+
+    from nanorlhf_tpu.trainer import AlgoName
+    from tests.test_trainer_smoke import make_trainer
+
+    tr = make_trainer(AlgoName.GRPO, tmp_path, rollout_prefix_cache=True,
+                      rollout_page_size=4, rollout_decode_rows=2,
+                      total_episodes=16)
+    assert tr.prefix_cache is not None
+    tr.train(num_updates=1)
+    rows = [_json.loads(ln) for ln in
+            (tmp_path / "grpo" / "metrics.jsonl").read_text().splitlines()]
+    row = rows[-1]
+    assert row["rollout/prefix_hit_frac"] > 0.0       # n=2 fanout repeats
+    assert row["pages/shared"] > 0
+    assert tr.prefix_cache.stats["lookups"] > 0
+    # /statusz carries the inspectable tree snapshot
+    sz = tr._statusz()
+    assert sz["prefix_cache"]["lookups"] > 0

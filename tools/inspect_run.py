@@ -18,9 +18,15 @@ scores from `sample`/`reward` events the ledger already carries).
   python tools/inspect_run.py RUN_DIR --latency       # queue-wait + generation
                                                       # percentiles from the
                                                       # ledger alone
+  python tools/inspect_run.py statusz.json --serving  # serving engine +
+                                                      # radix prefix-cache
+                                                      # sections of a saved
+                                                      # /statusz snapshot
 
 RUN_DIR is the trainer's output_dir (containing `lineage/`) or the lineage
-directory itself. jax-free: runs anywhere the JSONL files can be read.
+directory itself; for --serving it is a saved /statusz JSON (curl the
+gateway's or trainer's /statusz into a file), or a directory containing
+`statusz.json`. jax-free: runs anywhere the JSONL files can be read.
 """
 
 import argparse
@@ -65,6 +71,56 @@ def latency_report(events) -> dict:
         "queue_wait_s": percentiles_from_samples(queue_waits),
         "generation_s": percentiles_from_samples(gen_s),
     }
+
+
+def serving_report(path: str) -> dict:
+    """Load a saved /statusz snapshot and pull out the serving engine and
+    radix prefix-cache sections. Accepts either shape: the gateway's
+    /statusz (the engine snapshot itself, with a nested `prefix_cache`)
+    or the trainer's /statusz (whose top-level `prefix_cache` is the
+    radix snapshot when `rollout_prefix_cache` is on)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "statusz.json")
+    with open(path) as f:
+        snap = json.load(f)
+    if isinstance(snap.get("counters"), dict):         # gateway /statusz
+        cache = snap.get("prefix_cache")
+        engine = {k: v for k, v in snap.items() if k != "prefix_cache"}
+        return {"engine": engine, "prefix_cache": cache}
+    return {"engine": None, "prefix_cache": snap.get("prefix_cache")}
+
+
+def _print_serving(rep: dict) -> None:
+    eng = rep["engine"]
+    if eng is not None:
+        print("serving engine:")
+        for k in ("rows", "active", "pending", "prompt_len",
+                  "max_new_tokens", "page_size", "num_pages",
+                  "prefill_token_dispatch"):
+            if k in eng:
+                print(f"  {k:<24s} {eng[k]}")
+        for k, v in sorted((eng.get("counters") or {}).items()):
+            print(f"  counters.{k:<15s} {v}")
+        slo = eng.get("slo") or {}
+        if slo:
+            print(f"  shed rule: {slo.get('rule')} "
+                  f"p{int(100 * slo.get('quantile', 0.95))} "
+                  f"> {slo.get('warn_s')}s after "
+                  f"{slo.get('warmup')} samples")
+    cache = rep["prefix_cache"]
+    if cache is None:
+        print("prefix cache: (absent — rollout_prefix_cache off, or "
+              "snapshot predates it)")
+        return
+    print("radix prefix cache:")
+    for k in ("nodes", "cached_pages", "free_pages", "num_pages",
+              "shared_pages", "page_size", "lookups", "lookup_tokens",
+              "hit_tokens", "hit_frac", "cow_splits", "evicted_pages",
+              "shared_pages_acquired", "inserted_nodes"):
+        if k in cache:
+            v = cache[k]
+            v = f"{v:.4f}" if isinstance(v, float) else v
+            print(f"  {k:<24s} {v}")
 
 
 def _fmt_time(ev, t0):
@@ -168,9 +224,26 @@ def main():
     ap.add_argument("--latency", action="store_true",
                     help="queue-wait + generation percentiles reconstructed "
                          "from the ledger (no live trainer needed)")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving engine + radix prefix-cache sections of "
+                         "a saved /statusz snapshot (run_dir is the JSON "
+                         "file, or a dir containing statusz.json)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
+
+    if args.serving:
+        try:
+            rep = serving_report(args.run_dir)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read a /statusz snapshot from "
+                  f"{args.run_dir}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep, sort_keys=True, default=str))
+        else:
+            _print_serving(rep)
+        return 0
 
     events = list(read_ledger(args.run_dir))
     if not events:
