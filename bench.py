@@ -61,6 +61,13 @@ BENCH_SERVING (1: also run the radix prefix-cache A/B and report
 detail.serving — radix on vs off at equal resident batch on a >= 50%
 prompt-overlap corpus; acceptance prefix_hit_frac > 0.4 with strictly
 fewer dispatched prefill tokens, greedy bit-identical, docs/SERVING.md),
+BENCH_ENV (1: also run the multi-turn environment A/B and report
+detail.env — 2-turn python-tool episodes vs the single-turn degenerate
+case at EQUAL resident batch, reporting turns/episode and the tool-stall
+overlap fraction; acceptance turns_per_episode >= 2 with observation
+tokens loss-masked and pages recycled mid-episode while single-turn
+stays at exactly 1 turn with zero continuation admissions,
+docs/ENVIRONMENTS.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -750,6 +757,102 @@ def _serving_check(jax) -> dict:
     }
 
 
+def _env_check(jax) -> dict:
+    """Multi-turn environment A/B (ISSUE 15, docs/ENVIRONMENTS.md): the
+    SAME episode driver at the SAME resident batch (decode_rows), a 2-turn
+    python-tool corpus vs the single-turn degenerate case. The 2-turn side
+    must average >= 2 turns/episode, loss-mask its observation tokens
+    False, and recycle pages through the continuation admissions (a
+    stalled tool holds zero KV capacity); tool_stall_overlap is the
+    fraction of continuation decode chunks that ran while at least one
+    tool call was still in flight — the latency-hiding signal. The
+    single-turn side never enters the continuation loop: exactly 1
+    turn/episode, mask all True, zero admissions. Tiny model + toy
+    tokenizer, runs on every backend; gate with BENCH_ENV=0."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.data import ToyTokenizer
+    from nanorlhf_tpu.envs import (
+        PythonToolEnv,
+        SingleTurnEnv,
+        run_env_episodes,
+    )
+    from nanorlhf_tpu.sampler import SamplingParams
+
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=tok.vocab_size)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    B, n_samp, Tp = 4, 2, 8
+    turn_tokens, obs_budget, resp, P = 16, 8, 48, 4
+    rows = B * n_samp
+    texts = [f"bench prompt {i} compute the answer" for i in range(B)]
+    ids = np.full((B, Tp), tok.pad_token_id, np.int32)
+    pmask = np.zeros((B, Tp), bool)
+    for i, t in enumerate(texts):
+        e = tok.encode(t)[:Tp]
+        ids[i, Tp - len(e):] = e
+        pmask[i, Tp - len(e):] = True
+    sampling = SamplingParams(max_tokens=turn_tokens, temperature=1.0,
+                              n=n_samp)
+    kw = dict(eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+              tokenizer=tok, turn_tokens=turn_tokens, obs_budget=obs_budget,
+              response_length=resp, page_size=P, decode_rows=rows // 2)
+
+    def reward(pairs, eos):
+        return [1.0] * len(pairs)
+
+    env2 = PythonToolEnv(reward_func=reward, max_turns=2)
+    # the toy tokenizer collapses whitespace, so fenced ```python blocks
+    # don't survive a decode round-trip — pin the extracted program (same
+    # move as tests/test_envs.py); the observation is still a REAL pooled
+    # subprocess execution, so tool walls and stalls are genuine
+    env2.extractor = lambda text: "print(6 * 7)"
+    env1 = SingleTurnEnv(reward_func=reward)
+
+    sides = {}
+    try:
+        for name, env, mt in (("multi", env2, 2), ("single", env1, 1)):
+            t0 = time.time()
+            out = run_env_episodes(
+                params, mcfg, jnp.asarray(ids), jnp.asarray(pmask),
+                jax.random.PRNGKey(7), sampling, env, max_turns=mt, **kw)
+            sec = time.time() - t0
+            st = out["stats"]
+            sides[name] = {
+                "turns_per_episode": round(st["env/turns_per_episode"], 3),
+                "obs_tokens_masked": int((~out["loss_mask"]).sum()),
+                "tool_wall_s": st["env/tool_wall_s"],
+                "tool_stall_overlap": round(st["env/tool_stall_overlap"], 3),
+                "stalled_rows": int(st["env/stalled_rows"]),
+                "admissions": int(out["admissions"]),
+                "pages_recycled": int(out["pages_recycled"]),
+                "sec": round(sec, 3),
+            }
+    finally:
+        env2.close()
+    multi, single = sides["multi"], sides["single"]
+    return {
+        "episodes": rows,
+        "decode_rows": rows // 2,
+        "page_size": P,
+        "turn_tokens": turn_tokens,
+        "obs_budget": obs_budget,
+        "response_length": resp,
+        "multi_turn": multi,
+        "single_turn": single,
+        "env_check": "ok" if (
+            multi["turns_per_episode"] >= 2.0
+            and multi["obs_tokens_masked"] > 0
+            and multi["admissions"] >= rows
+            and multi["pages_recycled"] > 0
+            and single["turns_per_episode"] == 1.0
+            and single["obs_tokens_masked"] == 0
+            and single["admissions"] == 0
+        ) else "MISMATCH",
+    }
+
+
 def _flash_on_chip_check(jax) -> dict:
     import jax.numpy as jnp
 
@@ -1424,6 +1527,16 @@ def run_bench(jax, init_error):
             serving_detail = _serving_check(jax)
         except Exception as e:
             serving_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    env_detail = None
+    if os.environ.get("BENCH_ENV", "1") == "1":
+        try:
+            # multi-turn environment A/B (tiny model, any backend) — the
+            # ISSUE-15 gate: 2-turn python-tool episodes average >= 2
+            # turns/episode at the same resident batch as single-turn,
+            # observation tokens loss-masked, pages recycled mid-episode
+            env_detail = _env_check(jax)
+        except Exception as e:
+            env_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     detail = {
         "backend": backend,
@@ -1446,6 +1559,7 @@ def run_bench(jax, init_error):
         "spec_decode": spec_decode_detail,
         **({"paged": paged_detail} if paged_detail is not None else {}),
         **({"serving": serving_detail} if serving_detail is not None else {}),
+        **({"env": env_detail} if env_detail is not None else {}),
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,
         "response_length": response_len,

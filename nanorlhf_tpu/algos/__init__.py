@@ -5,6 +5,8 @@ from nanorlhf_tpu.algos.advantages import (
     best_of_k_indices,
     keep_one_of_n_indices,
     sparse_terminal_rewards,
+    grpo_turn_advantage,
+    per_turn_terminal_rewards,
     discounted_returns,
     gae,
 )
@@ -24,6 +26,8 @@ __all__ = [
     "best_of_k_indices",
     "keep_one_of_n_indices",
     "sparse_terminal_rewards",
+    "grpo_turn_advantage",
+    "per_turn_terminal_rewards",
     "discounted_returns",
     "gae",
     "ppo_clip_loss_token",

@@ -106,6 +106,51 @@ def sparse_terminal_rewards(
     return rewards.at[jnp.arange(batch), actual_end].add(scores.astype(jnp.float32))
 
 
+def grpo_turn_advantage(turn_rewards: jnp.ndarray, sample_n: int) -> jnp.ndarray:
+    """Per-turn GRPO advantage: z-score each turn column within its group.
+
+    `turn_rewards` is [B*N, K] group-major (K = max turns; absent turns
+    hold 0 and a whole-group-absent column z-scores to 0 via the NaN→0
+    rule). Normalizing per (group, turn-column) instead of on episode
+    totals keeps the GRPO baseline semantics while crediting each turn
+    against the SAME turn of its siblings — a strong turn 2 after a weak
+    turn 1 is rewarded as such, not averaged away. Degenerate K=1 is
+    exactly `grpo_group_advantage`.
+    """
+    rows, k = turn_rewards.shape
+    groups = turn_rewards.reshape(-1, sample_n, k).astype(jnp.float32)
+    mean = groups.mean(axis=1, keepdims=True)
+    std = jnp.sqrt(
+        jnp.sum((groups - mean) ** 2, axis=1, keepdims=True) / (sample_n - 1)
+    )
+    adv = (groups - mean) / std
+    adv = jnp.where(jnp.isnan(adv), 0.0, adv)
+    return adv.reshape(rows, k)
+
+
+def per_turn_terminal_rewards(
+    turn_rewards: jnp.ndarray,
+    turn_ends: jnp.ndarray,
+    response_length: int,
+) -> jnp.ndarray:
+    """Sparse per-token rewards with one spike at EACH turn's final token.
+
+    Multi-turn generalization of `sparse_terminal_rewards`: `turn_ends`
+    [B, K] holds the response-coordinate index of each turn's last model
+    token (−1 for absent turns — dropped via out-of-range scatter). Running
+    `discounted_returns(γ=1)` over the result broadcasts each turn's
+    credit over the tokens that produced it AND every earlier turn —
+    reward-to-go per turn, the per-turn attribution the multi-turn GRPO
+    path scores with.
+    """
+    batch = turn_rewards.shape[0]
+    rewards = jnp.zeros((batch, response_length), jnp.float32)
+    ends = jnp.where(turn_ends < 0, response_length, turn_ends)
+    return rewards.at[
+        jnp.arange(batch)[:, None], ends
+    ].add(turn_rewards.astype(jnp.float32), mode="drop")
+
+
 def discounted_returns(rewards: jnp.ndarray, gamma: float) -> jnp.ndarray:
     """Reversed cumulative sum with discount: A_t = r_t + γ A_{t+1}.
 

@@ -62,6 +62,7 @@ LOCK_ORDER: tuple[str, ...] = (
     "orchestrator.meter",     # OverlapMeter._lock          (orchestrator.py)
     "telemetry.mfu.counter",  # RecompileCounter._lock      (mfu.py)
     "telemetry.mfu.registry", # _COUNTER_LOCK               (mfu.py)
+    "rewards.executor",       # PooledPythonExecutor._lock  (python_executor.py)
     "resilience.faults",      # FaultInjector._lock         (faults.py)
 )
 

@@ -13,7 +13,9 @@ def build_config(sequence_parallel: int = 1,
                  rollout_devices: int = 0,
                  rollout_workers: int = 1,
                  rollout_spec_k: int = 0,
-                 status_port: int = 0) -> RLConfig:
+                 status_port: int = 0,
+                 env_name: str = "",
+                 env_max_turns: int = 1) -> RLConfig:
     """`sequence_parallel > 1` routes the chunked logprob pass and the jitted
     update through ring attention with the sequence dim sharded over an sp
     mesh axis (response_length must divide by it).
@@ -39,7 +41,15 @@ def build_config(sequence_parallel: int = 1,
     `status_port != 0` serves the live run-health endpoints /metrics ·
     /healthz · /statusz on that port (-1 = ephemeral; docs/OBSERVABILITY.md
     §5). Health scoring itself is on regardless — this only exposes it
-    over HTTP."""
+    over HTTP.
+
+    `env_name` runs rollouts through a vectorized environment
+    (docs/ENVIRONMENTS.md): "single_turn" wraps the reward callable
+    (bit-identical to the default pipeline); "python_tool" with
+    `env_max_turns > 1` runs fenced ```python blocks as mid-episode tools
+    over the paged scheduler — multi-turn forces the paged continuous-
+    batching layout and turns off the knobs the episode driver replaces
+    (orchestrator, spec decode, logprob capture)."""
     cfg = RLConfig(
         algo=AlgoName.GRPO,
         exp_name="grpo-v1",
@@ -93,6 +103,26 @@ def build_config(sequence_parallel: int = 1,
         cfg.rollout_devices = rollout_devices
     cfg.rollout_spec_k = rollout_spec_k
     cfg.status_port = status_port
+    if env_name:
+        cfg.env_name = env_name
+        cfg.env_max_turns = env_max_turns
+        if env_max_turns > 1:
+            # the episode driver owns the rollout phase: paged continuous
+            # batching on, and the knobs it replaces off
+            if cfg.rollout_page_size <= 0:
+                cfg.rollout_page_size = 128
+            if cfg.rollout_decode_rows <= 0:
+                cfg.rollout_decode_rows = cfg.batch_size * cfg.sample_n // 2
+            cfg.rollout_orchestrator = False
+            cfg.rollout_workers = 1
+            cfg.sampler_logprob_capture = False
+            cfg.rollout_spec_k = 0
+            # half the budget per turn, the rest for the tool observations
+            cfg.env_turn_tokens = cfg.response_length // (2 * env_max_turns)
+            cfg.env_obs_budget = min(
+                256,
+                (cfg.response_length - cfg.env_turn_tokens * env_max_turns)
+                // max(1, env_max_turns - 1))
     return cfg
 
 

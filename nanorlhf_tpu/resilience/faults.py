@@ -50,6 +50,17 @@ is coverable deterministically with a `worker=I` selector):
                    closed — the receiver detects it by length+checksum
                    (recoverable, counts against the failure budget)
 
+Environment tool points (wired around the env.step tool dispatch in
+envs/rollout.py — `worker=I` selects the episode index):
+
+    env.hang       the tool call stalls `delay` seconds before running
+                   (default action "delay") — the stalled row's pages are
+                   already released, so this drives the
+                   release-while-stalled / re-admit path
+    env.crash      the tool call raises (default "raise") — the driver
+                   absorbs it into an error-text observation; the episode
+                   continues, never a dead rollout
+
 Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
 by ";" or whitespace):
 
@@ -116,6 +127,12 @@ INJECTION_POINTS = frozenset({
     "net.partition",
     "net.duplicate",
     "net.tear",
+    # environment tool sites (envs/rollout.py tool dispatch): env.hang
+    # stalls the tool call (default action=delay — drives the
+    # page-release-while-stalled path), env.crash raises inside it (the
+    # driver absorbs it as an error-text observation)
+    "env.hang",
+    "env.crash",
 })
 
 ACTIONS = ("raise", "nan", "hang", "delay",
@@ -131,6 +148,7 @@ _DEFAULT_ACTIONS = {
     "net.partition": "partition",
     "net.duplicate": "duplicate",
     "net.tear": "tear",
+    "env.hang": "delay",
 }
 
 

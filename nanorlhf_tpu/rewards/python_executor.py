@@ -94,6 +94,47 @@ def _exec_worker(code: str, answer_expr: str | None, q,
         sys.stdout = old_stdout
 
 
+def _pool_worker(jobs, results, answer_expr: str | None,
+                 cpu_seconds: int, mem_bytes: int | None, ready):
+    """Persistent exec loop: one spawn bootstrap, many snippets.
+
+    Same body as `_exec_worker` per job, but the ready handshake and the
+    rlimit/scratch-dir setup are paid ONCE; after that each job is a
+    (job_id, code) → (job_id, status, answer, stdout) round trip. A None
+    job is the shutdown sentinel. NOTE: RLIMIT_CPU is cumulative across
+    every snippet this worker ever runs — the parent's per-job wall-clock
+    timeout plus terminate→kill reaping is the real per-job bound, and a
+    worker killed mid-job is simply respawned on the next call.
+    """
+    ready.set()
+    _apply_child_limits(cpu_seconds, mem_bytes)
+    while True:
+        job = jobs.get()
+        if job is None:
+            return
+        job_id, code = job
+        buf = StringIO()
+        old_stdout = sys.stdout
+        sys.stdout = buf
+        try:
+            glb: dict = {"__name__": "__main__"}
+            exec(code, glb)  # noqa: S102 — isolated subprocess + timeout + rlimits
+            answer = ""
+            if answer_expr:
+                try:
+                    answer = repr(eval(answer_expr, glb))  # noqa: S307
+                except Exception:
+                    answer = ""
+            elif "answer" in glb:
+                answer = repr(glb["answer"])
+            results.put((job_id, "ok", answer, buf.getvalue()))
+        except Exception:
+            results.put((job_id, "err", "",
+                         buf.getvalue() + "\n" + traceback.format_exc()))
+        finally:
+            sys.stdout = old_stdout
+
+
 class PythonExecutor:
     """`run(code)` → ExecutionResult; `timeout` seconds per snippet.
 
@@ -155,3 +196,122 @@ class PythonExecutor:
         if status == "ok":
             return ExecutionResult(ok=True, answer=answer, stdout=stdout)
         return ExecutionResult(ok=False, stdout=stdout, error=stdout)
+
+
+class PooledPythonExecutor:
+    """`run(code)` against ONE warm worker process reused across calls.
+
+    The spawn-context bootstrap fence in `PythonExecutor` costs seconds per
+    child (the re-import of the parent's __main__ pulls jax); a terminal
+    grader pays that once per sample, but a mid-episode tool
+    (envs/python_tool.py) would pay it once per TURN. Here the fence is
+    paid once at (re)spawn: steady-state calls are a queue round trip into
+    the warm worker. The containment story is unchanged — same rlimits,
+    same per-call wall-clock `timeout`, same terminate→kill escalation
+    (`reap_process`) on overrun; a reaped worker is respawned lazily on
+    the next call, and monotonically increasing job ids let the parent
+    discard any stale result a killed worker managed to flush.
+
+    `run` is serialized under `make_lock("rewards.executor")` (declared in
+    analysis/lockorder.py) so the multi-turn driver's tool threads share
+    one warm worker safely; it never acquires other project locks.
+    """
+
+    def __init__(self, timeout: float = 5.0, answer_expr: str | None = None,
+                 cpu_seconds: int = 60, mem_bytes: int | None = None,
+                 mp_context: str = "spawn", term_grace: float = 2.0,
+                 bootstrap_timeout: float = 60.0):
+        from nanorlhf_tpu.analysis.lockorder import make_lock
+
+        self.timeout = timeout
+        self.answer_expr = answer_expr
+        # default cpu_seconds is higher than PythonExecutor's: RLIMIT_CPU
+        # accumulates over the worker's whole life, not per snippet
+        self.cpu_seconds = cpu_seconds
+        self.mem_bytes = mem_bytes
+        self.mp_context = mp_context
+        self.term_grace = term_grace
+        self.bootstrap_timeout = bootstrap_timeout
+        self._lock = make_lock("rewards.executor")
+        self._proc = None
+        self._jobs = None
+        self._results = None
+        self._next_job = 0
+
+    @property
+    def worker_pid(self) -> int | None:
+        """Pid of the live worker (None before first run / after reap) —
+        the pooling regression test pins this constant across calls."""
+        p = self._proc
+        return p.pid if p is not None and p.is_alive() else None
+
+    def _ensure_worker(self) -> bool:
+        if self._proc is not None and self._proc.is_alive():
+            return True
+        ctx = multiprocessing.get_context(self.mp_context)
+        self._jobs = ctx.Queue()
+        self._results = ctx.Queue()
+        ready = ctx.Event()
+        self._proc = ctx.Process(
+            target=_pool_worker,
+            args=(self._jobs, self._results, self.answer_expr,
+                  self.cpu_seconds, self.mem_bytes, ready),
+            daemon=True,
+        )
+        self._proc.start()
+        deadline = time.monotonic() + self.bootstrap_timeout
+        while (not ready.is_set() and self._proc.is_alive()
+               and time.monotonic() < deadline):
+            ready.wait(0.05)
+        return ready.is_set()
+
+    def _reap(self):
+        from nanorlhf_tpu.resilience import reap_process
+
+        if self._proc is not None:
+            reap_process(self._proc, self.term_grace)
+        self._proc = None
+
+    def run(self, code: str) -> ExecutionResult:
+        with self._lock:
+            if not self._ensure_worker():
+                self._reap()
+                return ExecutionResult(ok=False, error="worker bootstrap failed")
+            job_id = self._next_job
+            self._next_job += 1
+            self._jobs.put((job_id, code))
+            deadline = time.monotonic() + self.timeout
+            while True:
+                try:
+                    rid, status, answer, stdout = self._results.get(
+                        timeout=max(0.0, deadline - time.monotonic()) + 0.05)
+                except Exception:
+                    rid = None
+                if rid == job_id:
+                    if status == "ok":
+                        return ExecutionResult(ok=True, answer=answer,
+                                               stdout=stdout)
+                    return ExecutionResult(ok=False, stdout=stdout,
+                                           error=stdout)
+                if rid is not None and rid < job_id:
+                    continue  # stale flush from a previously killed job
+                if not self._proc.is_alive():
+                    self._reap()
+                    return ExecutionResult(ok=False,
+                                           error="no result (crashed?)")
+                # timed out: kill the wedged worker; next call respawns
+                self._reap()
+                return ExecutionResult(
+                    ok=False, error=f"timeout after {self.timeout}s")
+
+    def close(self):
+        with self._lock:
+            if self._proc is not None and self._proc.is_alive():
+                try:
+                    self._jobs.put(None)
+                    self._proc.join(self.term_grace)
+                except Exception:
+                    pass
+                if self._proc.is_alive():
+                    self._reap()
+            self._proc = None

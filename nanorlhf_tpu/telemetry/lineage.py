@@ -208,6 +208,21 @@ class LineageLedger:
             segments=segments, **fields,
         )
 
+    def turn(self, rollout_index: int, *, step=None, row=None, turn=None,
+             tool_wall_s=None, obs_range=None, obs_tokens=None, reward=None,
+             tok_range=None, **fields) -> int:
+        # one event per (episode row, turn) from the multi-turn env driver
+        # (envs/rollout.py): `tok_range` is the turn's model-token span and
+        # `obs_range` the observation span, both in response coordinates —
+        # the same coordinate space as generation `segments`, so turn
+        # events join generation events on rollout_index
+        return self.event(
+            "turn", rollout_index, step=step, row=row, turn=turn,
+            tool_wall_s=tool_wall_s, obs_range=obs_range,
+            obs_tokens=obs_tokens, reward=reward, tok_range=tok_range,
+            **fields,
+        )
+
     def queue(self, rollout_index: int, *, enqueue_t=None, dequeue_t=None,
               staleness=None, policy_version=None, **fields) -> int:
         return self.event(

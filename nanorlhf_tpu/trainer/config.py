@@ -331,6 +331,28 @@ class RLConfig:
     # it only pays when rollout prompts overlap.
     rollout_prefix_cache: bool = False
 
+    # ---- environments (envs/, docs/ENVIRONMENTS.md) ----
+    # "" = no environment (the classic reward_func pipeline, unchanged).
+    # "single_turn" wraps reward_func into SingleTurnEnv — bit-identical
+    # to "" (parity-pinned). "python_tool" runs fenced ```python blocks
+    # as mid-episode tools over the pooled executor; multi-turn requires
+    # GRPO + rollout_page_size > 0 (the continuation turns ride the paged
+    # admission path) and is incompatible with the orchestrator fleet,
+    # sampler logprob capture, spec decode, and the prefix cache.
+    env_name: str = ""
+    # episode turn budget; 1 = single-turn semantics for any env
+    env_max_turns: int = 1
+    # per-turn generation budget (tokens); 0 = response_length. Multi-turn
+    # requires env_turn_tokens*max_turns + env_obs_budget*(max_turns-1)
+    # <= response_length so the packed episode fits the scored batch.
+    env_turn_tokens: int = 0
+    # max observation tokens appended per tool call
+    env_obs_budget: int = 64
+    # wall-clock seconds per tool call (pooled executor per-job timeout)
+    env_tool_timeout: float = 5.0
+    # resident rows in the multi-turn continuation loop; 0 = all episodes
+    env_decode_rows: int = 0
+
     # ---- resilience (resilience/, docs/RESILIENCE.md) ----
     # fault-injection spec ("point:at=N,..."); None falls back to the
     # NANORLHF_FAULT env var; empty arms nothing. Injection points:

@@ -81,6 +81,14 @@ class SparseGRPOTrainer(RLTrainer):
 
     def __init__(self, *args, accuracy_func: Optional[Callable] = None, **kwargs):
         super().__init__(*args, **kwargs)
+        if self._env_multi_turn:
+            # single-turn envs work (RLTrainer unwraps them into a plain
+            # reward callable, which _call_reward dispatches unchanged);
+            # the multi-turn episode driver is wired into the DENSE
+            # runtime's rollout phase only
+            raise ValueError(
+                "SparseGRPOTrainer does not drive multi-turn environments "
+                "(env_max_turns > 1) — use the dense RLTrainer")
         self.accuracy_func = accuracy_func
         self._len_menu = shape_menu(
             self.cfg.response_length + self.dataset.input_ids.shape[1], min_value=32
@@ -158,9 +166,14 @@ class SparseGRPOTrainer(RLTrainer):
                     logits, mb["responses"], cfg.temperature
                 )
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
+            mask = ~mb["padding_mask"]
+            if "loss_mask" in mb:
+                # env observation tokens: conditioned on, never scored
+                # (dense runtime's microbatch_loss composes the same way)
+                mask = mask & mb["loss_mask"]
             loss, aux = grpo_loss(
                 new_lp, mb["logprobs"], mb["ref_logprobs"], mb["advantages"],
-                ~mb["padding_mask"], cfg.cliprange, cfg.kl_coef,
+                mask, cfg.cliprange, cfg.kl_coef,
             )
             aux["entropy"] = entropy
             return loss * loss_scale, aux
@@ -234,9 +247,13 @@ class SparseGRPOTrainer(RLTrainer):
             )
             new_lp = new_lp[:, context_length - 1 : -1]
             new_lp = jnp.where(mb["padding_mask"], INVALID_LOGPROB, new_lp)
+            mask = ~mb["padding_mask"]
+            if "loss_mask" in mb:
+                # env observation tokens: conditioned on, never scored
+                mask = mask & mb["loss_mask"]
             loss, aux = grpo_loss(
                 new_lp, mb["logprobs"], mb["ref_logprobs"], mb["advantages"],
-                ~mb["padding_mask"], cfg.cliprange, cfg.kl_coef,
+                mask, cfg.cliprange, cfg.kl_coef,
             )
             # the global [B, T, V] logits never materialize under SP (that's
             # the point) — the entropy stat is a per-shard mean pmean'd over

@@ -41,7 +41,9 @@ from nanorlhf_tpu.algos import (
     discounted_returns,
     gae,
     grpo_group_advantage,
+    grpo_turn_advantage,
     keep_one_of_n_indices,
+    per_turn_terminal_rewards,
     remax_advantage,
     rloo_advantage,
     sparse_terminal_rewards,
@@ -623,6 +625,59 @@ class RLTrainer:
                     "to cache across)")
             from nanorlhf_tpu.serving.radix import RadixCache
             self.prefix_cache = RadixCache()
+        # environments (envs/, docs/ENVIRONMENTS.md): env_name builds an
+        # Environment around reward_func. A SINGLE-TURN env unwraps back
+        # into a plain reward callable, so generation, reward dispatch
+        # (retries, the reward.exec fault site), and every metric stay on
+        # the exact non-env code path — the parity pin holds by
+        # construction. MULTI-TURN swaps the rollout phase for the paged
+        # episode driver (envs/rollout.py) and threads a per-token
+        # loss_mask through the scored batch.
+        self.env = None
+        self._env_multi_turn = False
+        if config.env_name:
+            from nanorlhf_tpu.envs import build_env
+
+            self.env = build_env(
+                config.env_name, reward_func,
+                max_turns=config.env_max_turns,
+                tool_timeout=config.env_tool_timeout,
+                eos_token=tokenizer.eos_token,
+            )
+            if self.env.max_turns == 1:
+                self.reward_func = self.env.as_reward_func()
+            else:
+                self._env_multi_turn = True
+                if self.algo != AlgoName.GRPO:
+                    raise ValueError(
+                        "multi-turn environments (env_max_turns > 1) are "
+                        "wired for GRPO only: per-turn advantages ride the "
+                        "group z-score path")
+                if config.rollout_page_size <= 0:
+                    raise ValueError(
+                        "env_max_turns > 1 requires rollout_page_size > 0: "
+                        "continuation turns are admitted through the paged "
+                        "single-row bucketed prefill")
+                if (config.rollout_orchestrator or config.rollout_workers > 1
+                        or config.rollout_spec_k > 0
+                        or config.sampler_logprob_capture
+                        or config.rollout_prefix_cache):
+                    raise ValueError(
+                        "env_max_turns > 1 is incompatible with the "
+                        "orchestrator fleet, spec decode, sampler logprob "
+                        "capture, and the prefix cache — the episode driver "
+                        "owns the rollout phase")
+                tt = config.env_turn_tokens or config.response_length
+                budget = (tt * config.env_max_turns
+                          + config.env_obs_budget * (config.env_max_turns - 1))
+                if budget > config.response_length:
+                    raise ValueError(
+                        f"episode budget {budget} (env_turn_tokens={tt} * "
+                        f"{config.env_max_turns} turns + env_obs_budget="
+                        f"{config.env_obs_budget} * "
+                        f"{config.env_max_turns - 1} observations) exceeds "
+                        f"response_length={config.response_length} — the "
+                        "packed episode must fit the scored batch")
         # run-health plane (telemetry/health.py, docs/OBSERVABILITY.md §5):
         # every metrics row folds through streaming aggregates + anomaly
         # rules; CRIT dumps a reason="health" blackbox through the tracer
@@ -1237,6 +1292,14 @@ class RLTrainer:
                 mb["padding_mask"], INVALID_LOGPROB, new_logprobs
             )
             mask = ~mb["padding_mask"]
+            # multi-turn environments: observation/tool tokens are
+            # conditioned on but never scored — the env driver's per-token
+            # loss_mask (False on observation spans) joins the pad mask
+            # here, upstream of every algorithm branch. The key is absent
+            # outside env multi-turn runs, so the degenerate case compiles
+            # the identical program.
+            if "loss_mask" in mb:
+                mask = mask & mb["loss_mask"]
             # behavior (stale sampling policy) logprobs for truncated IS —
             # None keeps every loss in its exact synchronous form
             behavior = mb["behavior_logprobs"] if use_is else None
@@ -1568,6 +1631,19 @@ class RLTrainer:
             page_size=cfg.rollout_page_size,
             decode_rows=cfg.rollout_decode_rows,
         )
+        if self._env_multi_turn:
+            # per-TURN generation budget: the episode driver packs model
+            # turns + observations into the response_length-wide scored
+            # batch, so each generate leg only runs env_turn_tokens
+            sampling = SamplingParams(
+                temperature=cfg.temperature, top_p=cfg.top_p, n=n,
+                max_tokens=cfg.env_turn_tokens or cfg.response_length,
+                top_k=cfg.rollout_top_k,
+                approx_top_k=cfg.rollout_approx_top_k,
+                shared_prompt_prefill=cfg.rollout_shared_prefill,
+                page_size=cfg.rollout_page_size,
+                decode_rows=cfg.rollout_decode_rows,
+            )
 
         # after a resume, the default budget is the REMAINING updates, not a
         # fresh full run
@@ -1609,6 +1685,25 @@ class RLTrainer:
             # ignored.
             spec_stats: list = []
             paged_stats: list = []
+            if self._env_multi_turn:
+                from nanorlhf_tpu.envs.rollout import run_env_episodes
+
+                payload = run_env_episodes(
+                    gen_params, self._rollout_mcfg, queries_j, prompt_mask,
+                    gen_key, sampling, self.env,
+                    eos_token_id=eos_id, pad_token_id=pad_id, tokenizer=tok,
+                    max_turns=cfg.env_max_turns,
+                    turn_tokens=sampling.max_tokens,
+                    obs_budget=cfg.env_obs_budget,
+                    response_length=cfg.response_length,
+                    page_size=cfg.rollout_page_size,
+                    decode_rows=(cfg.env_decode_rows
+                                 or cfg.rollout_decode_rows),
+                    lora_scale=self.lora_scale, faults=self.faults,
+                )
+                return {"queries": queries, "gen_out": payload["tokens"],
+                        "greedy": None, "spec_stats": None,
+                        "paged_stats": None, "env": payload}
             gen_out = generate(
                 gen_params, self._rollout_mcfg, queries_j, prompt_mask, gen_key,
                 sampling, eos_token_id=eos_id, pad_token_id=pad_id,
@@ -1836,13 +1931,35 @@ class RLTrainer:
             question_n = [q for q in question_strings for _ in range(n)]
             responses_np = np.asarray(responses)
             responses_decoded = tok.batch_decode(responses_np)
+            envp = ro.get("env")
             with self.timer.phase("reward"):
-                scores = self._dispatch_reward(
-                    [q + r for q, r in zip(question_n, responses_decoded)],
-                    tok.eos_token,
-                    rollout_index=rollout_index,
-                    step=self.state["global_step"],
-                )
+                if envp is not None:
+                    # multi-turn env: rewards accrued turn-by-turn inside
+                    # the episode driver (the terminal grader already ran
+                    # per episode) — no separate dispatch. Lineage gets the
+                    # usual reward event plus one `turn` event per
+                    # (episode row, turn), joinable to this rollout's
+                    # generation event on rollout_index.
+                    scores = np.asarray(envp["scores"], np.float32)
+                    if self.lineage.enabled:
+                        self.lineage.reward(
+                            rollout_index, step=self.state["global_step"],
+                            scores=[round(float(s), 6) for s in scores],
+                            attempt=1,
+                            wall_s=envp["stats"]["env/tool_wall_s"],
+                        )
+                        for rec in envp["turns"]:
+                            self.lineage.turn(
+                                rollout_index,
+                                step=self.state["global_step"], **rec,
+                            )
+                else:
+                    scores = self._dispatch_reward(
+                        [q + r for q, r in zip(question_n, responses_decoded)],
+                        tok.eos_token,
+                        rollout_index=rollout_index,
+                        step=self.state["global_step"],
+                    )
             log_scores_all = scores.copy()  # raw sampled-rollout scores for logging
             if greedy_responses is not None:
                 greedy_decoded = tok.batch_decode(np.asarray(greedy_responses))
@@ -1858,12 +1975,27 @@ class RLTrainer:
 
             # ---- GRPO: group advantage + keep-1-of-N BEFORE scoring --------
             grpo_adv = None
+            env_turn_adv = env_turn_ends = env_loss_mask = None
             if self.algo == AlgoName.GRPO:
                 adv_flat = np.asarray(grpo_group_advantage(jnp.asarray(scores), n))
                 self.key, k = jax.random.split(self.key)
                 keep = np.asarray(keep_one_of_n_indices(k, batch_size, n))
                 rows = np.arange(batch_size)
                 grpo_adv = adv_flat.reshape(batch_size, n)[rows, keep]
+                if envp is not None:
+                    # per-turn advantages z-score each turn column against
+                    # the FULL group (all N siblings) before the keep
+                    # filter drops N−1 of them, mirroring the episode-level
+                    # baseline above; the turn-end positions and the
+                    # observation loss_mask ride the same selection
+                    t_adv = np.asarray(grpo_turn_advantage(
+                        jnp.asarray(envp["turn_rewards"]), n))
+                    env_turn_adv = t_adv.reshape(
+                        batch_size, n, -1)[rows, keep]
+                    env_turn_ends = np.asarray(envp["turn_ends"]).reshape(
+                        batch_size, n, -1)[rows, keep]
+                    env_loss_mask = np.asarray(envp["loss_mask"]).reshape(
+                        batch_size, n, -1)[rows, keep]
                 responses_np = responses_np.reshape(batch_size, n, -1)[rows, keep]
                 if captured_lp is not None:
                     captured_lp = captured_lp.reshape(batch_size, n, -1)[rows, keep]
@@ -1940,7 +2072,11 @@ class RLTrainer:
             # ---- response post-processing ---------------------------------
             responses_j = jnp.asarray(responses_np)
             postprocessed = responses_j
-            if stop_id is not None:
+            if stop_id is not None and envp is None:
+                # multi-turn episodes carry INTERIOR per-turn EOS tokens the
+                # stop-token truncation would cut at; the driver already
+                # packed real tokens left-justified with pads only at the
+                # tail, so the first-pad seq_lengths below stay correct
                 postprocessed = truncate_response(stop_id, pad_id, responses_j)
             seq_lengths = np.asarray(first_true_indices(postprocessed == pad_id) - 1)
             padding_mask, padding_mask_p1 = response_padding_masks(
@@ -1969,7 +2105,15 @@ class RLTrainer:
                 scores_sel, logprobs, ref_logprobs, padding_mask, padding_mask_p1,
                 seq_lengths, qr, responses_np, context_length, batch_size, n,
                 behavior_lp=behavior_lp,
+                turn_info=((env_turn_adv, env_turn_ends)
+                           if env_turn_adv is not None else None),
             )
+            if env_loss_mask is not None:
+                # observation/tool tokens: conditioned on, never scored.
+                # The key is only present in env multi-turn runs, so every
+                # other mode compiles the identical jitted update.
+                batch["loss_mask"] = env_loss_mask
+
             if keep_inds is not None:
                 # RLOO/RAFT selected 1-of-N *after* the logprob pass; realign
                 # the decoded strings/scores used for the sample table
@@ -2138,6 +2282,8 @@ class RLTrainer:
             metrics["time/rollout_overlap_frac"] = meter.overlap_fraction()
             metrics.update(self._spec_decode_metrics(ro.get("spec_stats")))
             metrics.update(self._paged_metrics(ro.get("paged_stats")))
+            if envp is not None:
+                metrics.update(envp["stats"])
             if use_orch:
                 ostats = orch.stats()
                 metrics.update({
@@ -2664,7 +2810,8 @@ class RLTrainer:
 
     def _assemble_batch(self, scores, logprobs, ref_logprobs, padding_mask,
                         padding_mask_p1, seq_lengths, qr, responses,
-                        context_length, batch_size, n, behavior_lp=None):
+                        context_length, batch_size, n, behavior_lp=None,
+                        turn_info=None):
         cfg = self.cfg
         T = responses.shape[1]
         kl = logprobs - ref_logprobs
@@ -2682,9 +2829,20 @@ class RLTrainer:
 
         if self.algo == AlgoName.GRPO:
             # sparse terminal advantage, reversed cumsum γ=1, KL stays in-loss
-            rewards = np.asarray(sparse_terminal_rewards(
-                jnp.asarray(scores), jnp.asarray(seq_lengths), T
-            ))
+            if turn_info is not None:
+                # multi-turn env episodes: one spike at EACH turn's final
+                # model token (per-turn group z-scored advantages from
+                # grpo_turn_advantage) instead of one terminal spike — the
+                # γ=1 reversed cumsum below then broadcasts each turn's
+                # credit as reward-to-go over the tokens that produced it
+                turn_adv, turn_ends = turn_info
+                rewards = np.asarray(per_turn_terminal_rewards(
+                    jnp.asarray(turn_adv), jnp.asarray(turn_ends), T
+                ))
+            else:
+                rewards = np.asarray(sparse_terminal_rewards(
+                    jnp.asarray(scores), jnp.asarray(seq_lengths), T
+                ))
             if cfg.whiten_rewards:
                 rewards = np.asarray(masked_whiten(
                     jnp.asarray(rewards), jnp.asarray(~padding_mask_p1), shift_mean=True

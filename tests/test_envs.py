@@ -1,0 +1,498 @@
+"""Vectorized multi-turn environments (ISSUE 15, docs/ENVIRONMENTS.md).
+
+The gate by name:
+- the episode driver runs 2-turn python-tool episodes over the paged
+  scheduler's admission/recycling machinery (pages released BEFORE the
+  tool runs, re-admission through the mid-loop prefill path), with
+  deterministic per-(episode, turn) admission keys;
+- a 2-update GRPO run on that env completes with >= 2 turns/episode in
+  metrics.jsonl, every observation token loss_mask=False asserted
+  against the ASSEMBLED batch mask, and `turn` lineage events joinable
+  to `generation` events;
+- SingleTurnEnv pins bit-identical (metrics minus wall-clock keys) to
+  the bare-reward-func pipeline;
+- the pooled executor reuses one warm worker across calls and survives
+  a timeout with terminate→kill→respawn;
+- inspect_run --turns rebuilds per-episode timelines from the ledger
+  alone; and the env.hang / env.crash fault sites stall / degrade to an
+  error observation without killing the rollout.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.data import ToyTokenizer, load_prompt_dataset
+from nanorlhf_tpu.envs import (
+    PythonToolEnv,
+    SingleTurnEnv,
+    build_env,
+    extract_python_block,
+    run_env_episodes,
+)
+from nanorlhf_tpu.parallel import MeshConfig
+from nanorlhf_tpu.resilience import FaultInjector, parse_fault_spec
+from nanorlhf_tpu.rewards.python_executor import PooledPythonExecutor
+from nanorlhf_tpu.sampler import SamplingParams
+from nanorlhf_tpu.telemetry import chains, read_ledger
+from nanorlhf_tpu.trainer import AlgoName, RLConfig, RLTrainer
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "inspect_run.py")
+
+# the toy tokenizer collapses whitespace, so fenced ```python blocks don't
+# survive a decode round-trip — tests pin the extracted program through the
+# public extractor hook; the observation is still a real tool execution
+PINNED_PROGRAM = "print(6 * 7)"
+
+
+def text_reward(pairs, eos_token):
+    """Deterministic text-only reward — identical answers on identical
+    token streams, so the parity pin can compare metrics exactly."""
+    return np.asarray(
+        [float(len(s.split()) % 5) + (1.0 if eos_token in s else 0.0)
+         for s in pairs],
+        np.float32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# jax-free units: interface, extraction, advantages, executor, inspector
+# ---------------------------------------------------------------------------
+
+
+def test_extract_python_block_takes_last_fenced_block():
+    text = ("thought ```python\nprint(1)\n``` more "
+            "```python\nprint(2)\n``` done")
+    assert extract_python_block(text).strip() == "print(2)"
+    assert extract_python_block("no code here") is None
+
+
+def test_single_turn_env_round_trip_matches_reward_func():
+    env = build_env("single_turn", text_reward, eos_token="</s>")
+    fn = env.as_reward_func()
+    pairs = ["a b c", "d e </s>", "f"]
+    got = np.asarray(fn(pairs, "</s>"))
+    want = text_reward(pairs, "</s>")
+    assert np.array_equal(got, want)
+    with pytest.raises(ValueError):
+        build_env("single_turn", text_reward, max_turns=2)
+    with pytest.raises(ValueError):
+        build_env("no_such_env", text_reward)
+
+
+def test_python_tool_env_steps_and_terminal_reward():
+    env = PythonToolEnv(text_reward, max_turns=2)
+    env.eos_token = "</s>"
+    try:
+        st = env.reset(["q: "])
+        obs, rew, done = env.step(
+            st, ["```python\nprint(6 * 7)\n```"], indices=[0])
+        assert not done[0] and rew[0] == 0.0
+        assert "42" in obs[0]              # real subprocess stdout fed back
+        obs2, rew2, done2 = env.step(st, ["final answer 42"], indices=[0])
+        assert done2[0] and obs2[0] == ""
+        assert rew2[0] == text_reward([st.prompts[0] + st.transcripts[0]],
+                                      "</s>")[0]
+    finally:
+        env.close()
+
+
+def test_grpo_turn_advantage_degenerates_to_group_advantage():
+    from nanorlhf_tpu.algos import grpo_group_advantage, grpo_turn_advantage
+
+    rng = np.random.default_rng(0)
+    r = rng.normal(size=(8, 1)).astype(np.float32)     # K=1: one turn
+    t = np.asarray(grpo_turn_advantage(jnp.asarray(r), 4))
+    g = np.asarray(grpo_group_advantage(jnp.asarray(r[:, 0]), 4))
+    np.testing.assert_allclose(t[:, 0], g, rtol=1e-6, atol=1e-6)
+    # K=2: each turn column is z-scored within its group independently
+    r2 = rng.normal(size=(8, 2)).astype(np.float32)
+    t2 = np.asarray(grpo_turn_advantage(jnp.asarray(r2), 4))
+    for k in range(2):
+        np.testing.assert_allclose(
+            t2[:, k],
+            np.asarray(grpo_group_advantage(jnp.asarray(r2[:, k]), 4)),
+            rtol=1e-6, atol=1e-6)
+
+
+def test_per_turn_terminal_rewards_spikes_and_absent_turns():
+    from nanorlhf_tpu.algos import per_turn_terminal_rewards
+
+    adv = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    ends = jnp.asarray([[3, 7], [5, -1]])              # -1 = turn never ran
+    dense = np.asarray(per_turn_terminal_rewards(adv, ends, 10))
+    want = np.zeros((2, 10), np.float32)
+    want[0, 3], want[0, 7], want[1, 5] = 1.0, 2.0, 3.0
+    np.testing.assert_allclose(dense, want)            # the -1 column dropped
+
+
+def test_pooled_executor_warm_reuse_and_timeout_respawn():
+    ex = PooledPythonExecutor(timeout=20.0)
+    try:
+        r1 = ex.run("print('alpha'); answer = 6 * 7")
+        assert r1.ok and "alpha" in r1.stdout and r1.answer == "42"
+        pid1 = ex.worker_pid
+        assert pid1 is not None
+        r2 = ex.run("print('beta')")
+        assert r2.ok and "beta" in r2.stdout
+        assert ex.worker_pid == pid1, "second call must reuse the warm worker"
+        r3 = ex.run("raise RuntimeError('boom')")
+        assert not r3.ok and "boom" in r3.error
+        assert ex.worker_pid == pid1, "a snippet error must not kill the worker"
+    finally:
+        ex.close()
+    assert ex.worker_pid is None
+
+
+def test_pooled_executor_timeout_reaps_then_respawns():
+    ex = PooledPythonExecutor(timeout=1.0)
+    try:
+        assert ex.run("x = 1").ok
+        pid1 = ex.worker_pid
+        r = ex.run("import time; time.sleep(60)")
+        assert not r.ok and "timeout" in r.error
+        assert ex.worker_pid is None, "the wedged worker must be reaped"
+        r2 = ex.run("print('back')")
+        assert r2.ok and "back" in r2.stdout
+        assert ex.worker_pid is not None and ex.worker_pid != pid1
+    finally:
+        ex.close()
+
+
+def test_inspect_run_turns_report_from_ledger_alone(tmp_path):
+    from nanorlhf_tpu.telemetry import LineageLedger
+
+    led = LineageLedger(str(tmp_path))
+    for idx in range(3):
+        led.generation(idx, policy_version=0, gen_s=0.1)
+        for t in range(1, 3):
+            led.turn(idx, step=0, row=idx, turn=t, tool_wall_s=0.25,
+                     obs_range=[16, 20] if t == 1 else None,
+                     obs_tokens=4 if t == 1 else 0,
+                     reward=float(t), tok_range=[0, 16])
+    led.close()
+    out = subprocess.run(
+        [sys.executable, TOOLS, str(tmp_path), "--turns", "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    rep = json.loads(out.stdout)
+    assert rep["turns_per_episode"] == 2.0
+    assert len(rep["episodes"]) == 3
+    for ep in rep["episodes"]:
+        assert ep["turns"] == 2
+        assert ep["obs_tokens"] == [4, 0]
+        assert ep["rewards"] == [1.0, 2.0]
+        assert ep["tool_wall_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# episode driver over the paged scheduler (tiny model, CPU)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model():
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    return tok, mcfg, params
+
+
+def _driver_prompts(tok, B, Tp):
+    ids = np.full((B, Tp), tok.pad_token_id, np.int32)
+    mask = np.zeros((B, Tp), bool)
+    for i in range(B):
+        e = tok.encode(f"prompt {i} compute the answer now")[:Tp]
+        ids[i, Tp - len(e):] = e
+        mask[i, Tp - len(e):] = True
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+class EchoEnv(PythonToolEnv):
+    """PythonToolEnv with the executor swapped for a canned observation —
+    driver-mechanics tests don't need a subprocess per turn."""
+
+    def __init__(self, reward_func, max_turns=2, obs_text=" tool says 42 "):
+        super().__init__(reward_func, max_turns=max_turns,
+                         executor=_NullExecutor(obs_text),
+                         extractor=lambda text: PINNED_PROGRAM)
+
+
+class _NullExecutor:
+    def __init__(self, obs_text):
+        self.obs_text = obs_text
+
+    def run(self, code):
+        from nanorlhf_tpu.rewards.python_executor import ExecutionResult
+
+        return ExecutionResult(ok=True, stdout=self.obs_text)
+
+    def close(self):
+        pass
+
+
+def _run_driver(env, *, faults=None, key=7, B=2, n=2, Tp=8,
+                turn_tokens=12, obs_budget=8, resp=40, decode_rows=2,
+                greedy=False):
+    tok, mcfg, params = _tiny_model()
+    ids, mask = _driver_prompts(tok, B, Tp)
+    env.eos_token = tok.eos_token
+    sampling = SamplingParams(max_tokens=turn_tokens, temperature=1.0, n=n,
+                              greedy=greedy)
+    try:
+        return tok, run_env_episodes(
+            params, mcfg, ids, mask, jax.random.PRNGKey(key), sampling, env,
+            eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+            tokenizer=tok, max_turns=env.max_turns, turn_tokens=turn_tokens,
+            obs_budget=obs_budget, response_length=resp, page_size=4,
+            decode_rows=decode_rows, faults=faults,
+        )
+    finally:
+        env.close()
+
+
+def test_driver_two_turns_masked_obs_and_page_recycling():
+    tok, out = _run_driver(EchoEnv(text_reward, max_turns=2))
+    rows = out["tokens"].shape[0]
+    assert rows == 4
+    st = out["stats"]
+    assert st["env/turns_per_episode"] == 2.0
+    assert st["env/obs_tokens"] > 0
+    assert st["env/tool_errors"] == 0.0
+    # every episode re-admitted exactly once through the mid-loop prefill
+    # path, releasing its turn-1 pages first
+    assert out["admissions"] == rows
+    assert out["pages_recycled"] > 0
+    # the loss mask is False EXACTLY on the recorded observation spans
+    expected = np.ones_like(out["loss_mask"])
+    for rec in out["turns"]:
+        if rec["obs_range"]:
+            a, b = rec["obs_range"]
+            expected[rec["row"], a:b] = False
+            assert rec["obs_tokens"] == b - a > 0
+    assert np.array_equal(out["loss_mask"], expected)
+    assert (~out["loss_mask"]).sum() > 0
+    # per-turn bookkeeping: 2 turn records per episode, ends ascending,
+    # scores are the summed per-turn rewards
+    for ep in range(rows):
+        recs = [r for r in out["turns"] if r["row"] == ep]
+        assert [r["turn"] for r in recs] == [1, 2]
+        e1, e2 = out["turn_ends"][ep]
+        assert 0 <= e1 < e2 < out["tokens"].shape[1]
+    np.testing.assert_allclose(out["scores"], out["turn_rewards"].sum(1))
+
+
+def test_driver_greedy_stream_is_schedule_independent():
+    """Tool completion order races through thread scheduling; a greedy
+    episode stream must not care — each row's logits depend only on its
+    own pages, and admission keys are (episode, turn)-derived, so row
+    placement and decode-chunk timing never change the tokens."""
+    _, a = _run_driver(EchoEnv(text_reward, max_turns=2), greedy=True)
+    _, b = _run_driver(EchoEnv(text_reward, max_turns=2), greedy=True)
+    assert np.array_equal(a["tokens"], b["tokens"])
+    assert np.array_equal(a["loss_mask"], b["loss_mask"])
+    assert np.array_equal(a["turn_ends"], b["turn_ends"])
+
+
+def test_driver_fault_sites_hang_delays_and_crash_degrades():
+    faults = FaultInjector(parse_fault_spec(
+        "env.hang:at=1,delay=0.3,worker=0 env.crash:at=1,worker=1"))
+    tok, out = _run_driver(EchoEnv(text_reward, max_turns=2), faults=faults)
+    st = out["stats"]
+    # every episode still completes its 2 turns — the crash became an
+    # error-text observation, not a dead rollout — and the absorption is
+    # counted loudly (the absorbed turn scores 0, so this metric is the
+    # only signal distinguishing "tool broke" from "tool scored 0")
+    assert st["env/turns_per_episode"] == 2.0
+    assert st["env/tool_errors"] == 1.0
+    recs = {(r["row"], r["turn"]): r for r in out["turns"]}
+    assert recs[(0, 1)]["tool_wall_s"] >= 0.3           # env.hang stalled it
+    crash = recs[(1, 1)]
+    assert crash["obs_range"] is not None
+    a, b = crash["obs_range"]
+    assert "InjectedFault" in tok.decode(out["tokens"][1, a:b])
+
+
+# ---------------------------------------------------------------------------
+# trainer end-to-end (2-update GRPO) + the single-turn parity pin
+# ---------------------------------------------------------------------------
+
+
+def _env_trainer(tmp_path, name, **overrides):
+    tok = ToyTokenizer(vocab_size=256)
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=256)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    cfg = RLConfig(
+        algo=AlgoName.GRPO,
+        output_dir=str(tmp_path / name),
+        response_length=48,
+        temperature=1.0,
+        sample_n=2,
+        kl_coef=0.0,
+        total_episodes=32,                 # batch 1*1*2 × world 8 = 16 → 2 updates
+        per_device_train_batch_size=1,
+        gradient_accumulation_steps=1,
+        num_mini_batches=2,
+        num_ppo_epochs=1,
+        learning_rate=1e-3,
+        logging_steps=1,
+        num_printed_samples=0,
+        use_lora=False,
+        gradient_checkpointing=False,
+        mesh=MeshConfig(-1, 1, 1),
+        save_steps=0,
+        load_best_model_at_end=False,
+        report_to="jsonl",
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    dataset = load_prompt_dataset("synthetic:64", tok, max_prompt_len=10)
+    return RLTrainer(cfg, mcfg, tok, params, dataset, text_reward)
+
+
+def _read_metrics(run_dir):
+    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_multi_turn_grpo_end_to_end(tmp_path):
+    """The ISSUE-15 acceptance run: 2 GRPO updates on the 2-turn
+    python-tool env over the paged scheduler."""
+    import nanorlhf_tpu.envs.rollout as envroll
+
+    tr = _env_trainer(
+        tmp_path, "env_e2e",
+        rollout_page_size=4, rollout_decode_rows=2,
+        env_name="python_tool", env_max_turns=2,
+        env_turn_tokens=16, env_obs_budget=8,
+        lineage=True,
+    )
+    assert isinstance(tr.env, PythonToolEnv) and tr._env_multi_turn
+    tr.env.extractor = lambda text: PINNED_PROGRAM
+
+    payloads, batches = [], []
+    orig_run = envroll.run_env_episodes
+    orig_asm = tr._assemble_batch
+
+    def run_wrap(*a, **k):
+        p = orig_run(*a, **k)
+        payloads.append(p)
+        return p
+
+    def asm_wrap(*a, **k):
+        out = orig_asm(*a, **k)
+        batches.append(out[0])   # the trainer mutates this dict in place
+        return out
+
+    envroll.run_env_episodes = run_wrap
+    tr._assemble_batch = asm_wrap
+    try:
+        state = tr.train()
+    finally:
+        envroll.run_env_episodes = orig_run
+        tr.env.close()
+    assert state["global_step"] == 2
+    assert len(payloads) == 2 and len(batches) == 2
+
+    # live metric rows: >= 2 turns/episode on every update
+    rows = _read_metrics(str(tmp_path / "env_e2e"))
+    assert rows
+    for row in rows:
+        assert row["env/turns_per_episode"] >= 2.0
+        assert row["env/obs_tokens"] > 0
+        assert 0.0 <= row["env/tool_stall_overlap"] <= 1.0
+
+    for payload, batch in zip(payloads, batches):
+        # the driver masked exactly the observation spans...
+        expected = np.ones_like(payload["loss_mask"])
+        n_obs = 0
+        for rec in payload["turns"]:
+            if rec["obs_range"]:
+                a, b = rec["obs_range"]
+                expected[rec["row"], a:b] = False
+                n_obs += b - a
+        assert n_obs > 0
+        assert np.array_equal(payload["loss_mask"], expected)
+        # ...and the ASSEMBLED batch carries those same masks through the
+        # GRPO keep-1-of-N selection: every batch row is one of the
+        # payload's episode masks, so every observation token trains at
+        # loss_mask=False
+        assert "loss_mask" in batch
+        bm = np.asarray(batch["loss_mask"])
+        assert (~bm).sum() > 0
+        payload_rows = {m.tobytes() for m in payload["loss_mask"]}
+        for r in bm:
+            assert r.tobytes() in payload_rows
+
+    # turn lineage events join generation events on rollout_index
+    events = list(read_ledger(str(tmp_path / "env_e2e")))
+    by_index = chains(events)
+    turn_evs = [e for e in events if e["type"] == "turn"]
+    assert turn_evs
+    for ev in turn_evs:
+        types = set(by_index[ev["rollout_index"]])   # {type: [events]}
+        assert "generation" in types and "reward" in types
+    # one turn event per (update, episode row, turn)
+    assert len(turn_evs) == sum(len(p["turns"]) for p in payloads)
+
+    # the offline inspector reproduces the live metric from the ledger
+    out = subprocess.run(
+        [sys.executable, TOOLS, str(tmp_path / "env_e2e"),
+         "--turns", "--json"],
+        capture_output=True, text=True, check=True,
+    )
+    rep = json.loads(out.stdout)
+    assert rep["turns_per_episode"] >= 2.0
+    assert len(rep["episodes"]) == sum(
+        p["tokens"].shape[0] for p in payloads)
+
+
+# wall-clock / throughput keys legitimately differ between two identical
+# runs; everything else must match exactly for the parity pin
+_TIMEY = re.compile(
+    r"(time|_s$|sec|mfu|perf|latency|wall|overlap|^t$|^t_mono$)",
+    re.IGNORECASE)
+
+
+def test_single_turn_env_bit_identical_to_bare_reward_func(tmp_path):
+    tr_bare = _env_trainer(tmp_path, "bare", response_length=16)
+    s1 = tr_bare.train()
+    tr_env = _env_trainer(tmp_path, "env", response_length=16,
+                          env_name="single_turn", env_max_turns=1)
+    assert isinstance(tr_env.env, SingleTurnEnv)
+    assert not tr_env._env_multi_turn
+    s2 = tr_env.train()
+    assert s1["global_step"] == s2["global_step"] == 2
+
+    rows_bare = _read_metrics(str(tmp_path / "bare"))
+    rows_env = _read_metrics(str(tmp_path / "env"))
+    assert len(rows_bare) == len(rows_env) > 0
+    for a, b in zip(rows_bare, rows_env):
+        ka = {k for k in a if not _TIMEY.search(k)}
+        kb = {k for k in b if not _TIMEY.search(k)}
+        assert ka == kb
+        for k in sorted(ka):
+            assert a[k] == b[k], f"metric {k!r} diverged: {a[k]} != {b[k]}"
+
+
+def test_multi_turn_config_validation(tmp_path):
+    # multi-turn without the paged scheduler is rejected up front
+    with pytest.raises(ValueError, match="rollout_page_size"):
+        _env_trainer(tmp_path, "bad_paged",
+                     env_name="python_tool", env_max_turns=2,
+                     env_turn_tokens=16, env_obs_budget=8)
+    # and so is a token budget the episode stream can't hold
+    with pytest.raises(ValueError, match="response_length"):
+        _env_trainer(tmp_path, "bad_budget",
+                     rollout_page_size=4, rollout_decode_rows=2,
+                     env_name="python_tool", env_max_turns=2,
+                     env_turn_tokens=32, env_obs_budget=8)

@@ -18,6 +18,12 @@ scores from `sample`/`reward` events the ledger already carries).
   python tools/inspect_run.py RUN_DIR --latency       # queue-wait + generation
                                                       # percentiles from the
                                                       # ledger alone
+  python tools/inspect_run.py RUN_DIR --turns         # per-episode turn
+                                                      # timelines (multi-turn
+                                                      # env runs): turn count,
+                                                      # tool wall, observation
+                                                      # lengths, per-turn
+                                                      # reward
   python tools/inspect_run.py statusz.json --serving  # serving engine +
                                                       # radix prefix-cache
                                                       # sections of a saved
@@ -71,6 +77,37 @@ def latency_report(events) -> dict:
         "queue_wait_s": percentiles_from_samples(queue_waits),
         "generation_s": percentiles_from_samples(gen_s),
     }
+
+
+def turns_report(events) -> dict:
+    """Reconstruct per-episode turn timelines from `turn` events ALONE —
+    the offline mirror of the live `env/*` metric rows (docs/METRICS.md).
+    One entry per (rollout_index, row) episode: turn count, summed tool
+    wall, observation token lengths, per-turn rewards, and each turn's
+    model-token range; `turns_per_episode` cross-checks the live metric."""
+    episodes: dict = {}
+    for ev in events:
+        if ev.get("type") != "turn":
+            continue
+        key = (ev.get("rollout_index"), ev.get("row"))
+        episodes.setdefault(key, []).append(ev)
+    out = []
+    for (idx, row), evs in sorted(episodes.items(),
+                                  key=lambda kv: (kv[0][0] or 0,
+                                                  kv[0][1] or 0)):
+        evs.sort(key=lambda e: e.get("turn", 0))
+        out.append({
+            "rollout_index": idx,
+            "row": row,
+            "turns": len(evs),
+            "tool_wall_s": round(
+                sum(e.get("tool_wall_s") or 0.0 for e in evs), 6),
+            "obs_tokens": [int(e.get("obs_tokens") or 0) for e in evs],
+            "rewards": [e.get("reward") for e in evs],
+            "tok_ranges": [e.get("tok_range") for e in evs],
+        })
+    tpe = (sum(e["turns"] for e in out) / len(out)) if out else 0.0
+    return {"episodes": out, "turns_per_episode": tpe}
 
 
 def serving_report(path: str) -> dict:
@@ -178,6 +215,14 @@ def _chain_timeline(idx, by_type, t0):
         elif etype == "sample":
             detail = (f"row {ev.get('row')} score {ev.get('score')} "
                       f"({len(ev.get('response', ''))} chars)")
+        elif etype == "turn":
+            detail = (f"row {ev.get('row')} turn {ev.get('turn')}: "
+                      f"tokens {ev.get('tok_range')}, "
+                      f"reward {ev.get('reward')}, "
+                      f"tool {ev.get('tool_wall_s', 0) or 0:.3f}s")
+            if ev.get("obs_range"):
+                detail += (f", obs {ev['obs_range']} "
+                           f"({ev.get('obs_tokens')} tokens)")
         lines.append(f"  {_fmt_time(ev, t0)}  {etype:<10s} {detail}")
     return "\n".join(lines)
 
@@ -224,6 +269,10 @@ def main():
     ap.add_argument("--latency", action="store_true",
                     help="queue-wait + generation percentiles reconstructed "
                          "from the ledger (no live trainer needed)")
+    ap.add_argument("--turns", action="store_true",
+                    help="per-episode turn timelines from `turn` events "
+                         "(multi-turn env runs): turn count, tool wall, "
+                         "observation lengths, per-turn reward")
     ap.add_argument("--serving", action="store_true",
                     help="serving engine + radix prefix-cache sections of "
                          "a saved /statusz snapshot (run_dir is the JSON "
@@ -280,6 +329,27 @@ def main():
                   f"p50={summ['p50_s']:.4f}s p95={summ['p95_s']:.4f}s "
                   f"p99={summ['p99_s']:.4f}s "
                   f"mean={summ['mean_s']:.4f}s max={summ['max_s']:.4f}s")
+        return 0
+
+    if args.turns:
+        rep = turns_report(events)
+        if args.json:
+            print(json.dumps(rep, sort_keys=True))
+            return 0
+        eps = rep["episodes"]
+        if not eps:
+            print("no `turn` events in the ledger (single-turn run, or "
+                  "env_max_turns == 1)")
+            return 0
+        print(f"{len(eps)} episodes, "
+              f"{rep['turns_per_episode']:.2f} turns/episode")
+        for e in eps:
+            rewards = ", ".join(
+                "?" if r is None else f"{r:.3f}" for r in e["rewards"])
+            obs = ", ".join(str(o) for o in e["obs_tokens"])
+            print(f"  rollout {e['rollout_index']} row {e['row']}: "
+                  f"{e['turns']} turns, tool {e['tool_wall_s']:.3f}s, "
+                  f"obs tokens [{obs}], rewards [{rewards}]")
         return 0
 
     if args.index is not None:
