@@ -68,6 +68,12 @@ overlap fraction; acceptance turns_per_episode >= 2 with observation
 tokens loss-masked and pages recycled mid-episode while single-turn
 stays at exactly 1 turn with zero continuation admissions,
 docs/ENVIRONMENTS.md),
+BENCH_TRAFFIC (1: also run the open-loop offered-load sweep and report
+detail.traffic — the SAME deterministic workload spec replayed against a
+fresh in-process ServingEngine at each rate on the BENCH_TRAFFIC_RATES
+grid ("4,16,64" rps); acceptance >= 3 points with goodput, shed-rate,
+and p95-TTFT columns, requests conserved at every point and the top rate
+shedding at least as much as the bottom, docs/TRAFFIC.md),
 BENCH_ATTEMPTS (2), BENCH_ATTEMPT_TIMEOUT (2100 s per attempt — sized for
 a baseline + int8-lever sweep; the sweep auto-skips when the baseline ate
 >40% of the budget), BENCH_SWEEP (1 on TPU: also measure the int8 levers,
@@ -77,6 +83,7 @@ bench on CPU and mark backend=cpu in the payload rather than emitting
 nothing).
 """
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -753,6 +760,103 @@ def _serving_check(jax) -> dict:
         "greedy_bit_identical": identical,
         "serving_check": "ok" if (
             identical and disp_on < disp_off and hit_frac > 0.4
+        ) else "MISMATCH",
+    }
+
+
+def _traffic_check(jax) -> dict:
+    """Goodput-vs-offered-load curve (ISSUE 16, docs/TRAFFIC.md): replay
+    the SAME deterministic workload spec (seed-folded prompts, greedy
+    sampling, prefix-family overlap) against a FRESH in-process
+    ServingEngine at each rate on a >= 3-point offered-load grid
+    (BENCH_TRAFFIC_RATES, rps), via the open-loop TrafficDriver — offered
+    load is the spec's, not the engine's, so past the knee the curve
+    shows shedding and TTFT degradation instead of silently slowing the
+    client. Checks: every point conserves requests (completed + shed +
+    errors == offered, errors == 0), and the highest rate sheds at least
+    as much as the lowest. Gate with BENCH_TRAFFIC=0."""
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.loadgen import (
+        TrafficDriver, WorkloadSpec, format_table, points_as_detail,
+        run_sweep, spec_digest,
+    )
+    from nanorlhf_tpu.serving.engine import ServingEngine
+
+    V, R, P, Tp, mx = 64, 2, 4, 12, 8
+    EOS, PAD = 3, 0
+    mcfg = ModelConfig.qwen2_tiny(vocab_size=V)
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    # the serving check's deterministic machine: zeroed layers + identity
+    # embedding make greedy generation a pure token permutation
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    for t in range(10, 50):
+        sigma[t] = t + 1
+    sigma[50] = EOS
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    spec = WorkloadSpec(
+        seed=0, n_requests=24, arrival="poisson",
+        prompt_len_min=4, prompt_len_max=Tp,
+        token_lo=10, token_hi=50, prefix_groups=3, prefix_frac=0.5,
+        prefix_len=4, greedy_frac=1.0,
+        max_tokens_min=mx, max_tokens_max=mx,
+    )
+    rates = [float(r) for r in os.environ.get(
+        "BENCH_TRAFFIC_RATES", "4,64,1024").split(",")]
+
+    def make_engine():
+        return ServingEngine(
+            params, mcfg, eos_token_id=EOS, pad_token_id=PAD,
+            page_size=P, prompt_len=Tp, max_new_tokens=mx, rows=R,
+            max_queue=4, slo_warn_ttft_s=1e9)
+
+    def run_point(point_spec):
+        # fresh engine per point: shed state and radix contents must not
+        # bleed across rates. slo_warn disabled so the only shed cause is
+        # the queue bound — the deterministic knee. max_queue=4 on 2 rows
+        # puts the knee inside the default grid.
+        engine = make_engine()
+        try:
+            driver = TrafficDriver(engine=engine, stream_timeout_s=60.0)
+            return driver.run(point_spec)
+        finally:
+            engine.close()
+
+    # warm the jit cache OUTSIDE the measured sweep: one discarded run of
+    # the same workload compiles every suffix-bucket/cow path the points
+    # will touch — otherwise compile lands on the first point's arrivals,
+    # backs up its queue, and inverts the curve (the LOWEST rate would
+    # shed the most)
+    run_point(dataclasses.replace(spec, rate_rps=16.0))
+
+    points = run_sweep(run_point, spec, rates)
+    print("offered-load sweep (in-process engine):", file=sys.stderr)
+    print(format_table(points), file=sys.stderr)
+    conserved = all(
+        p.completed + p.shed + p.errors == spec.n_requests
+        and p.errors == 0
+        for p in points)
+    monotone_knee = points[-1].shed >= points[0].shed
+    return {
+        "spec_digest": spec_digest(spec),
+        "n_requests": spec.n_requests,
+        "decode_rows": R,
+        "max_queue": 4,
+        "grid": points_as_detail(points),
+        "traffic_check": "ok" if (
+            len(points) >= 3 and conserved and monotone_knee
         ) else "MISMATCH",
     }
 
@@ -1527,6 +1631,15 @@ def run_bench(jax, init_error):
             serving_detail = _serving_check(jax)
         except Exception as e:
             serving_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    traffic_detail = None
+    if os.environ.get("BENCH_TRAFFIC", "1") == "1":
+        try:
+            # goodput-vs-offered-load sweep (tiny model, any backend) —
+            # the ISSUE-16 gate: >= 3 deterministic offered-load points
+            # with goodput, shed-rate, and p95-TTFT columns
+            traffic_detail = _traffic_check(jax)
+        except Exception as e:
+            traffic_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
     env_detail = None
     if os.environ.get("BENCH_ENV", "1") == "1":
         try:
@@ -1559,6 +1672,7 @@ def run_bench(jax, init_error):
         "spec_decode": spec_decode_detail,
         **({"paged": paged_detail} if paged_detail is not None else {}),
         **({"serving": serving_detail} if serving_detail is not None else {}),
+        **({"traffic": traffic_detail} if traffic_detail is not None else {}),
         **({"env": env_detail} if env_detail is not None else {}),
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,

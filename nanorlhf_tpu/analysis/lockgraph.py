@@ -41,6 +41,7 @@ SCOPE = (
     "nanorlhf_tpu/trainer/metrics.py",
     "nanorlhf_tpu/resilience/faults.py",
     "nanorlhf_tpu/serving/",
+    "nanorlhf_tpu/loadgen/",
 )
 
 # attr-name -> class-name receiver table for resolving self._attr.m() calls.

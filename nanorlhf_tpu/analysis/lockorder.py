@@ -46,7 +46,14 @@ import threading
 #     (the shed check reads hub TTFT quantiles under the engine's
 #      condition; serving.radix is ranked just below serving.engine so
 #      an admission that ever plans under the condition stays ascending)
+#   loadgen.autoscaler -> {fleet.coordinator, telemetry.{lineage,tracer}}
+#     (evaluate() actuates add/remove_worker and records the decision
+#      while holding the controller lock — ranked above everything)
+#   loadgen.driver ranks above serving.engine/telemetry.{hist,lineage}
+#     for the same reason, though the driver only guards bookkeeping
 LOCK_ORDER: tuple[str, ...] = (
+    "loadgen.autoscaler",     # Autoscaler._lock            (loadgen/autoscaler.py)
+    "loadgen.driver",         # TrafficDriver._lock         (loadgen/driver.py)
     "fleet.coordinator",      # FleetCoordinator._cond      (fleet.py)
     "orchestrator.queue",     # BoundedStalenessQueue._cond (sample_queue.py)
     "orchestrator.weights",   # VersionedWeightStore._cond  (weight_store.py)

@@ -52,6 +52,7 @@ METRIC_SCOPES = (
     "nanorlhf_tpu/telemetry/",
     "nanorlhf_tpu/sampler/",
     "nanorlhf_tpu/serving/",             # gateway/engine emit serving/*
+    "nanorlhf_tpu/loadgen/",             # traffic harness emits loadgen/*
     "nanorlhf_tpu/envs/",                # episode driver emits env/*
     "nanorlhf_tpu/utils/profiling.py",   # PhaseTimer emits time/{k}_s
 )

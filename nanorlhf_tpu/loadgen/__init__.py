@@ -1,0 +1,23 @@
+"""Traffic harness: deterministic open-loop load generation, SLO-driven
+autoscaling, and goodput sweeps (docs/TRAFFIC.md).
+
+- workload.py   — replayable WorkloadSpec → request sequence (jax-free,
+                  zero wall-clock; seed + spec replays bit-identically)
+- driver.py     — open-loop multi-threaded driver (in-process ServingEngine
+                  or HTTP gateway target); records client TTFT + outcomes
+- autoscaler.py — hysteresis controller: SLO verdicts → add/remove_worker
+- report.py     — offered-load sweep → goodput/shed/TTFT curve
+"""
+
+from nanorlhf_tpu.loadgen.workload import (  # noqa: F401
+    GenRequest, WorkloadSpec, requests_digest, sample_requests, spec_digest,
+)
+from nanorlhf_tpu.loadgen.driver import (  # noqa: F401
+    RequestRecord, TrafficDriver, TrafficSummary,
+)
+from nanorlhf_tpu.loadgen.autoscaler import (  # noqa: F401
+    Autoscaler, AutoscalerConfig, slo_level_from_monitor,
+)
+from nanorlhf_tpu.loadgen.report import (  # noqa: F401
+    SweepPoint, format_table, points_as_detail, run_sweep,
+)

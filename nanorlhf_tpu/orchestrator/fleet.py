@@ -141,6 +141,10 @@ class _WorkerRecord:
     samples: int = 0
     ewma_s: float = 0.0
     lost: bool = False
+    # drain-then-remove (elastic scale-in): a draining worker is still
+    # alive and may finish its in-flight leases, but the grant path
+    # skips it — no new work, then deregister once its leases complete
+    draining: bool = False
 
     def alive(self, now: float, timeout: float) -> bool:
         if self.lost:
@@ -257,6 +261,44 @@ class FleetCoordinator:
             self._check_exhausted_locked()
             self._cond.notify_all()
 
+    def drain_worker(self, worker_id: int) -> bool:
+        """Stop granting this worker new leases; its in-flight leases keep
+        running to completion (the first half of drain-then-remove —
+        wait_drained + deregister_worker finish the job). Returns False
+        for an unknown/lost worker."""
+        with self._cond:
+            rec = self._workers.get(worker_id)
+            if rec is None or rec.lost:
+                return False
+            rec.draining = True
+            if self._tracer is not None and self._tracer.enabled:
+                self._tracer.instant("fleet.drain", worker=worker_id)
+            self._cond.notify_all()
+            return True
+
+    def wait_drained(self, worker_id: int, timeout: float = 30.0) -> bool:
+        """Block until the worker holds no live lease (all completed or
+        revoked) or `timeout` real seconds pass. `complete()` prunes done
+        leases and notifies, so this wakes promptly."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while any(l.worker_id == worker_id and not l.revoked
+                      for l in self._leases.values()):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining,
+                                            self.cfg.poll_interval))
+                self._poll_locked()
+            return True
+
+    def live_worker_ids(self) -> list:
+        """Ids of members that have not left/been lost (draining workers
+        still count — they hold capacity until deregistered)."""
+        with self._cond:
+            return sorted(wid for wid, rec in self._workers.items()
+                          if not rec.lost)
+
     def heartbeat(self, worker_id: int):
         with self._cond:
             rec = self._workers.get(worker_id)
@@ -356,7 +398,7 @@ class FleetCoordinator:
     def _head_waiter_locked(self, now: float) -> Optional[int]:
         for wid in self._waiters:
             rec = self._workers.get(wid)
-            if rec is None or rec.lost:
+            if rec is None or rec.lost or rec.draining:
                 continue
             if rec.quarantined_until > now:
                 continue
@@ -1135,12 +1177,32 @@ class FleetOrchestrator:
         w.start()
         return w.worker_id
 
-    def remove_worker(self, worker_id: int):
-        """Graceful leave (elastic scale-down)."""
+    def remove_worker(self, worker_id: int, drain: bool = False,
+                      drain_timeout_s: float = 30.0) -> bool:
+        """Leave mid-run (elastic scale-down).
+
+        `drain=True` (what autoscaler scale-in uses): stop granting the
+        worker new leases, wait for its in-flight leases to complete,
+        THEN deregister — nothing is stranded and nothing needs the
+        lease-expiry reassignment sweep. Falls through to the abrupt
+        path if the drain times out (the reassignment machinery then
+        recovers whatever was left, same as a crash).
+
+        `drain=False` (default, kept for fault tests): immediate
+        deregister — in-flight leases are revoked and reassigned.
+
+        Returns True when the worker left cleanly drained (vacuously
+        True for the abrupt path)."""
+        drained = True
+        if drain:
+            if self.coordinator.drain_worker(worker_id):
+                drained = self.coordinator.wait_drained(
+                    worker_id, timeout=drain_timeout_s)
         self.coordinator.deregister_worker(worker_id)
         for w in self._workers:
             if w.worker_id == worker_id:
                 w.stop()
+        return drained
 
     # ---------------------------------------------------------------- #
     # consumer API (RolloutOrchestrator-compatible)

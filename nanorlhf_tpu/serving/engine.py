@@ -273,6 +273,11 @@ class ServingEngine:
         self._ids = itertools.count()
         self._counters = {"requests": 0, "admitted": 0, "shed": 0,
                           "completed": 0}
+        # per-cause shed counters (serving/shed_total{reason=...}):
+        # pre-seeded so every reason exports a 0 row from the first
+        # scrape — dashboards can alert on rate() without init gaps
+        self._shed_reasons = {"queue_full": 0, "slo_ttft_p95": 0,
+                              "closed": 0, "pool": 0}
         self._dispatch_tokens = 0
         self._it_prev = 0
         self._thread = threading.Thread(target=self._loop,
@@ -301,6 +306,8 @@ class ServingEngine:
             reason = self._shed_reason_locked()
             if reason is not None:
                 self._counters["shed"] += 1
+                self._shed_reasons[reason] = (
+                    self._shed_reasons.get(reason, 0) + 1)
                 return None, reason
             req = ServingRequest(
                 request_id=next(self._ids), tokens=toks,
@@ -382,6 +389,8 @@ class ServingEngine:
             # the rest evictable) — shed rather than crash if it fires
             with self._cond:
                 self._counters["shed"] += 1
+                self._shed_reasons["pool"] = (
+                    self._shed_reasons.get("pool", 0) + 1)
                 self._n_active -= 1
             req.out_q.put(None)
             return
@@ -463,15 +472,23 @@ class ServingEngine:
     # observability
     # ------------------------------------------------------------- #
 
+    def queue_depth(self) -> int:
+        """Live pending-queue length — the autoscaler's leading-indicator
+        input (loadgen/autoscaler.py `queue_high`): the queue fills
+        before p95 TTFT degrades enough to flip an SLO rule."""
+        with self._cond:
+            return len(self._pending)
+
     def metrics(self) -> dict:
         """Flat scalar row for /metrics — the serving/* registry keys
         (METRICS.md) plus the pool's live shared-page gauge."""
         with self._cond:
             c = dict(self._counters)
+            reasons = dict(self._shed_reasons)
             pending = len(self._pending)
             active = self._n_active
         snap = self._radix.snapshot()
-        return {
+        rows = {
             "serving/requests": c["requests"],
             "serving/admitted": c["admitted"],
             "serving/shed": c["shed"],
@@ -485,12 +502,16 @@ class ServingEngine:
             "serving/prefill_token_dispatch": self._dispatch_tokens,
             "pages/shared": snap["shared_pages"],
         }
+        for reason, n in sorted(reasons.items()):
+            rows[f'serving/shed_total{{reason="{reason}"}}'] = n
+        return rows
 
     def snapshot(self) -> dict:
         """JSON-able /statusz section: engine shape + live occupancy +
         the radix tree's own snapshot under `prefix_cache`."""
         with self._cond:
             c = dict(self._counters)
+            reasons = dict(self._shed_reasons)
             pending = len(self._pending)
             active = self._n_active
         return {
@@ -502,6 +523,7 @@ class ServingEngine:
             "page_size": self.page_size,
             "num_pages": self.num_pages,
             "counters": c,
+            "shed_reasons": reasons,
             "prefill_token_dispatch": self._dispatch_tokens,
             "slo": {"rule": "slo_ttft_p95", "warn_s": self._slo_warn,
                     "quantile": self._slo_q, "warmup": self._slo_warmup},
@@ -523,6 +545,8 @@ class ServingEngine:
             pending = list(self._pending)
             self._pending.clear()
             self._counters["shed"] += len(pending)
+            self._shed_reasons["closed"] = (
+                self._shed_reasons.get("closed", 0) + len(pending))
             self._cond.notify_all()
         for req in pending:
             req.out_q.put(None)

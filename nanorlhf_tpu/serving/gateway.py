@@ -11,8 +11,8 @@ silenced — plus one streaming endpoint:
   request finishes. `"stream": true`: chunked `application/x-ndjson`,
   one `{"token": t}` line as each token lands, then a final
   `{"done": true, "n": count}` line. Admission control answers 429
-  with the shed reason (`queue_full` / `slo_ttft_p95`) instead of
-  queueing unboundedly.
+  with the shed reason (`queue_full` / `slo_ttft_p95`) and a
+  `Retry-After` hint instead of queueing unboundedly.
 - `GET /metrics`    Prometheus text: the engine's serving/* gauges
   plus the LatencyHub histogram families when the engine has one.
 - `GET /healthz`    200 `ok` while the engine loop runs, 503 after
@@ -39,6 +39,13 @@ from nanorlhf_tpu.telemetry.exporter import (
 )
 
 _LOOPBACK = ("127.0.0.1", "localhost", "::1")
+
+# Retry-After seconds by shed cause: queue_full clears as soon as a row
+# frees (~one decode round), an SLO breach needs the p95 window to move,
+# and a closed engine is not coming back on this port soon. Advisory for
+# well-behaved closed-loop clients — the open-loop loadgen driver
+# records the header but never obeys it.
+_RETRY_AFTER = {"queue_full": 1, "slo_ttft_p95": 5, "closed": 30}
 
 
 class ServingGateway:
@@ -110,8 +117,12 @@ class ServingGateway:
                     max_tokens=spec.get("max_tokens"),
                 )
                 if req is None:
-                    self._write(429, "application/json", json.dumps(
-                        {"error": "shed", "reason": reason}).encode())
+                    self._write(
+                        429, "application/json",
+                        json.dumps({"error": "shed",
+                                    "reason": reason}).encode(),
+                        headers={"Retry-After":
+                                 str(_RETRY_AFTER.get(reason, 5))})
                     return
                 if spec.get("stream"):
                     self.send_response(200)
@@ -133,10 +144,12 @@ class ServingGateway:
 
             # ---- plumbing ------------------------------------------ #
 
-            def _write(self, status, ctype, body: bytes):
+            def _write(self, status, ctype, body: bytes, headers=None):
                 self.send_response(status)
                 self.send_header("Content-Type", f"{ctype}; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 

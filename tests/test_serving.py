@@ -399,9 +399,17 @@ def test_gateway_sheds_on_slo_and_answers_429(served):
     with pytest.raises(urllib.error.HTTPError) as err:
         _post(base, {"tokens": [1, 2, 3]})
     assert err.value.code == 429
+    # open-loop clients and dashboards read the cause: Retry-After header
+    # (the SLO-shed backoff hint) + the per-reason shed counter family
+    assert err.value.headers.get("Retry-After") == "5"
     assert json.loads(err.value.read())["reason"] == "slo_ttft_p95"
-    shed_before = eng.metrics()["serving/shed"]
+    m = eng.metrics()
+    shed_before = m["serving/shed"]
     assert shed_before >= 1
+    assert m['serving/shed_total{reason="slo_ttft_p95"}'] >= 1
+    assert m['serving/shed_total{reason="queue_full"}'] == 0  # pre-seeded
+    assert sum(v for k, v in m.items()
+               if k.startswith("serving/shed_total{")) == shed_before
     # restore: overwrite the histogram with fast observations is not
     # possible (streaming), so later tests must not submit — this is the
     # module's final gateway test by ordering; still verify the engine

@@ -28,6 +28,11 @@ scores from `sample`/`reward` events the ledger already carries).
                                                       # radix prefix-cache
                                                       # sections of a saved
                                                       # /statusz snapshot
+  python tools/inspect_run.py RUN_DIR --traffic       # offered-load/goodput/
+                                                      # shed timeline + auto-
+                                                      # scale decisions from
+                                                      # `traffic`/`autoscale`
+                                                      # events alone
 
 RUN_DIR is the trainer's output_dir (containing `lineage/`) or the lineage
 directory itself; for --serving it is a saved /statusz JSON (curl the
@@ -108,6 +113,107 @@ def turns_report(events) -> dict:
         })
     tpe = (sum(e["turns"] for e in out) / len(out)) if out else 0.0
     return {"episodes": out, "turns_per_episode": tpe}
+
+
+def traffic_report(events) -> dict:
+    """Reconstruct a loadgen run from the ledger ALONE (docs/TRAFFIC.md):
+    per-outcome counts and shed reasons from `traffic` events, offered/
+    goodput rates over the spec's arrival span, client-TTFT percentiles
+    through the same digest the live hub cross-checks against
+    (hist.percentiles_from_samples), a per-second offered/completed/shed
+    timeline binned on each request's deterministic `t_offset`, and the
+    autoscaler's decision list from `autoscale` events."""
+    fired = [ev for ev in events if ev.get("type") == "traffic"]
+    runs = [ev for ev in events if ev.get("type") == "traffic_run"]
+    scales = [ev for ev in events if ev.get("type") == "autoscale"]
+    outcomes: dict = {}
+    reasons: dict = {}
+    timeline: dict = {}
+    ttfts = []
+    max_off = 0.0
+    for ev in fired:
+        out = ev.get("outcome") or "unknown"
+        outcomes[out] = outcomes.get(out, 0) + 1
+        if out == "shed":
+            r = ev.get("reason") or "unknown"
+            reasons[r] = reasons.get(r, 0) + 1
+        if isinstance(ev.get("ttft_s"), (int, float)):
+            ttfts.append(ev["ttft_s"])
+        off = ev.get("t_offset")
+        if isinstance(off, (int, float)):
+            max_off = max(max_off, off)
+            sec = int(off)
+            bin_ = timeline.setdefault(
+                sec, {"offered": 0, "completed": 0, "shed": 0, "errors": 0})
+            bin_["offered"] += 1
+            bin_[out if out in bin_ else "errors"] += 1
+    n = len(fired)
+    completed = outcomes.get("completed", 0)
+    span = max_off if max_off > 0 else None
+    return {
+        "runs": [{k: v for k, v in ev.items()
+                  if k in ("spec_digest", "n_requests", "rate_rps",
+                           "arrival", "seed", "mode", "time_scale",
+                           "key_path")}
+                 for ev in runs],
+        "offered": n,
+        "outcomes": outcomes,
+        "shed_reasons": reasons,
+        "offered_rps": round(n / span, 4) if span else None,
+        "goodput_rps": round(completed / span, 4) if span else None,
+        "shed_frac": round(outcomes.get("shed", 0) / n, 4) if n else None,
+        "client_ttft_s": percentiles_from_samples(ttfts),
+        "timeline": [{"second": s, **timeline[s]}
+                     for s in sorted(timeline)],
+        "autoscale": [{k: ev.get(k)
+                       for k in ("action", "worker_id", "workers_before",
+                                 "workers_after", "level", "queue_depth",
+                                 "eval")}
+                      for ev in scales],
+    }
+
+
+def _print_traffic(rep: dict) -> None:
+    for run in rep["runs"]:
+        print(f"workload: {run.get('n_requests')} requests @ "
+              f"{run.get('rate_rps')} rps ({run.get('arrival')}), "
+              f"seed {run.get('seed')}, spec {run.get('spec_digest')}, "
+              f"mode {run.get('mode')}")
+    n = rep["offered"]
+    if not n:
+        print("no `traffic` events in the ledger (loadgen never ran, or "
+              "lineage was off)")
+        return
+    print(f"{n} requests: {rep['outcomes']}")
+    if rep["shed_reasons"]:
+        print("shed reasons:")
+        for r, c in sorted(rep["shed_reasons"].items(),
+                           key=lambda kv: -kv[1]):
+            print(f"  {r:<16s} {c}")
+    if rep["offered_rps"] is not None:
+        print(f"offered {rep['offered_rps']:.2f} rps, goodput "
+              f"{rep['goodput_rps']:.2f} rps, shed "
+              f"{100.0 * rep['shed_frac']:.1f}% (over the spec's arrival "
+              f"span — unscaled t_offset seconds)")
+    t = rep["client_ttft_s"]
+    if t["count"]:
+        print(f"client TTFT: n={t['count']} p50={t['p50_s']:.4f}s "
+              f"p95={t['p95_s']:.4f}s p99={t['p99_s']:.4f}s "
+              f"max={t['max_s']:.4f}s")
+    if rep["timeline"]:
+        print("per-second timeline (by spec arrival offset):")
+        for b in rep["timeline"]:
+            print(f"  t+{b['second']:<4d} offered {b['offered']:<4d} "
+                  f"completed {b['completed']:<4d} shed {b['shed']:<4d} "
+                  f"errors {b['errors']}")
+    if rep["autoscale"]:
+        print("autoscale decisions:")
+        for d in rep["autoscale"]:
+            print(f"  eval {d.get('eval'):<4} {d.get('action'):<10s} "
+                  f"worker {d.get('worker_id')} "
+                  f"({d.get('workers_before')}->{d.get('workers_after')} "
+                  f"workers), level {d.get('level')}, queue "
+                  f"{d.get('queue_depth')}")
 
 
 def serving_report(path: str) -> dict:
@@ -273,6 +379,10 @@ def main():
                     help="per-episode turn timelines from `turn` events "
                          "(multi-turn env runs): turn count, tool wall, "
                          "observation lengths, per-turn reward")
+    ap.add_argument("--traffic", action="store_true",
+                    help="offered-load/goodput/shed timeline + autoscale "
+                         "decisions reconstructed from `traffic`/"
+                         "`autoscale` events alone (docs/TRAFFIC.md)")
     ap.add_argument("--serving", action="store_true",
                     help="serving engine + radix prefix-cache sections of "
                          "a saved /statusz snapshot (run_dir is the JSON "
@@ -329,6 +439,14 @@ def main():
                   f"p50={summ['p50_s']:.4f}s p95={summ['p95_s']:.4f}s "
                   f"p99={summ['p99_s']:.4f}s "
                   f"mean={summ['mean_s']:.4f}s max={summ['max_s']:.4f}s")
+        return 0
+
+    if args.traffic:
+        rep = traffic_report(events)
+        if args.json:
+            print(json.dumps(rep, sort_keys=True))
+            return 0
+        _print_traffic(rep)
         return 0
 
     if args.turns:
