@@ -9,6 +9,11 @@ These rules diff the two views so they cannot drift:
                                     point is missing from RESILIENCE.md
   registry.fault-site-unwired       a RESILIENCE.md table row no code
                                     fires
+  registry.invariant-undocumented   a `chaos.*` invariant name checked
+                                    by an auditor but missing from the
+                                    RESILIENCE.md invariant table
+  registry.invariant-unchecked      a RESILIENCE.md invariant-table row
+                                    no auditor checks
   registry.metric-undocumented      a metric key referenced in code
                                     (emitted OR read) missing from
                                     METRICS.md
@@ -64,6 +69,11 @@ _NOT_METRICS = {"text/plain", "text/html", "application/json",
 _KEY_RE = re.compile(r'^[a-z][a-z0-9_]*/[a-z0-9_]+(\{[a-z_]+="[^"]*"\})?$')
 _FSTR_SEG_RE = re.compile(r'^[a-z0-9_/{}="]*$')
 _FAULT_RE = re.compile(r"^[a-z_]+\.[a-z_]+$")
+# chaos run-invariant names (chaos/auditors.py INVARIANTS) — collected
+# only from nanorlhf_tpu/chaos/ modules, diffed against the RESILIENCE.md
+# `| invariant |` table in both directions
+_INVARIANT_RE = re.compile(r"^chaos\.[a-z_]+$")
+_INVARIANT_SCOPE = "nanorlhf_tpu/chaos/"
 
 # histogram metric families (telemetry/hist.py): a key under this prefix
 # is exported as Prometheus HISTOGRAM exposition — three derived sample
@@ -113,6 +123,27 @@ def parse_fault_tables(text: str) -> set[str]:
     return sites
 
 
+def parse_invariant_tables(text: str) -> set[str]:
+    """Backticked first-cell names from RESILIENCE.md `| invariant |`
+    tables — same grammar as the fault-site tables, different header."""
+    names: set[str] = set()
+    in_table = False
+    for line in text.splitlines():
+        s = line.strip()
+        if s.startswith("|") and "invariant" in s.split("|")[1].lower():
+            in_table = True
+            continue
+        if not s.startswith("|"):
+            in_table = False
+            continue
+        if in_table:
+            first = s.split("|")[1]
+            for tok in re.findall(r"`([^`]+)`", first):
+                if _INVARIANT_RE.match(tok):
+                    names.add(tok)
+    return names
+
+
 def parse_metric_doc(text: str) -> tuple[set[str], list[str]]:
     """(exact names, wildcard names-with-'*') from METRICS.md first cells."""
     exact: set[str] = set()
@@ -151,13 +182,16 @@ def _expand_doc_name(tok: str) -> list[str]:
 # ---------------------------------------------------------------------------
 
 class _CodeInventory(ast.NodeVisitor):
-    def __init__(self, relpath: str, collect_metrics: bool):
+    def __init__(self, relpath: str, collect_metrics: bool,
+                 collect_invariants: bool = False):
         self.relpath = relpath
         self.collect_metrics = collect_metrics
+        self.collect_invariants = collect_invariants
         self.fires: list[tuple[str, int]] = []          # (point, line)
         self.keys: list[tuple[str, int]] = []           # (literal key, line)
         self.patterns: list[tuple[str, int]] = []       # (regex source, line)
         self.health_metrics: list[tuple[str, int]] = []
+        self.invariants: list[tuple[str, int]] = []     # (chaos.* name, line)
         self._not_keys: set[int] = set()   # Constant node ids to skip
 
     def visit_Call(self, node: ast.Call):
@@ -182,6 +216,9 @@ class _CodeInventory(ast.NodeVisitor):
                 and id(node) not in self._not_keys \
                 and _KEY_RE.match(node.value):
             self.keys.append((node.value, node.lineno))
+        if self.collect_invariants and isinstance(node.value, str) \
+                and _INVARIANT_RE.match(node.value):
+            self.invariants.append((node.value, node.lineno))
 
     def visit_JoinedStr(self, node: ast.JoinedStr):
         if not self.collect_metrics:
@@ -209,7 +246,9 @@ def run(proj: Project) -> list[Finding]:
 
     res_md = root / "docs" / "RESILIENCE.md"
     met_md = root / "docs" / "METRICS.md"
-    doc_sites = parse_fault_tables(res_md.read_text()) if res_md.exists() else set()
+    res_text = res_md.read_text() if res_md.exists() else ""
+    doc_sites = parse_fault_tables(res_text)
+    doc_invariants = parse_invariant_tables(res_text)
     doc_exact, doc_wild = (parse_metric_doc(met_md.read_text())
                            if met_md.exists() else (set(), []))
 
@@ -217,9 +256,11 @@ def run(proj: Project) -> list[Finding]:
     keys: dict[str, tuple[str, int]] = {}
     patterns: list[tuple[str, str, int]] = []   # (regex, path, line)
     health: list[tuple[str, str, int]] = []
+    invariants: dict[str, tuple[str, int]] = {}
     for src in proj.iter_trees():
         in_scope = src.relpath.startswith(METRIC_SCOPES)
-        inv = _CodeInventory(src.relpath, in_scope)
+        inv = _CodeInventory(src.relpath, in_scope,
+                             src.relpath.startswith(_INVARIANT_SCOPE))
         inv.visit(src.tree)
         for point, line in inv.fires:
             fires.setdefault(point, (src.relpath, line))
@@ -227,6 +268,8 @@ def run(proj: Project) -> list[Finding]:
             keys.setdefault(k, (src.relpath, line))
         patterns.extend((rx, src.relpath, line) for rx, line in inv.patterns)
         health.extend((m, src.relpath, line) for m, line in inv.health_metrics)
+        for name, line in inv.invariants:
+            invariants.setdefault(name, (src.relpath, line))
 
     # --- fault sites <-> RESILIENCE.md -------------------------------------
     for point, (path, line) in sorted(fires.items()):
@@ -242,6 +285,21 @@ def run(proj: Project) -> list[Finding]:
             line=1, detail=f"doc:{point}",
             message=f"RESILIENCE.md documents fault point `{point}` but no "
                     f'code calls fire("{point}")'))
+
+    # --- chaos invariants <-> RESILIENCE.md --------------------------------
+    for name, (path, line) in sorted(invariants.items()):
+        if name not in doc_invariants:
+            findings.append(Finding(
+                rule="registry.invariant-undocumented", path=path, line=line,
+                detail=f"invariant:{name}",
+                message=f"chaos invariant '{name}' has no row in the "
+                        f"RESILIENCE.md invariant table"))
+    for name in sorted(doc_invariants - set(invariants)):
+        findings.append(Finding(
+            rule="registry.invariant-unchecked", path="docs/RESILIENCE.md",
+            line=1, detail=f"doc:{name}",
+            message=f"RESILIENCE.md documents invariant `{name}` but no "
+                    f"chaos auditor checks it"))
 
     # --- metric keys <-> METRICS.md ----------------------------------------
     wild_prefixes = [w.split("*")[0] for w in doc_wild]

@@ -1,0 +1,22 @@
+"""Chaos soak harness (docs/RESILIENCE.md §chaos).
+
+Composes multi-site fault schedules from the wired injection-point
+registry under the splitmix64 lineage-PRNG discipline (composer.py),
+drives a full end-to-end run under them (runner.py), audits the run's
+GLOBAL invariants from the lineage ledger + component snapshots
+(auditors.py), and — on any auditor failure — delta-debugs the spec
+down to a minimal failing clause set with a one-line repro command
+(shrink.py). `python -m nanorlhf_tpu.chaos` is the CLI entry point.
+"""
+
+from nanorlhf_tpu.chaos.auditors import (  # noqa: F401
+    AuditResult, AUDITORS, INVARIANTS, run_audits,
+)
+from nanorlhf_tpu.chaos.composer import (  # noqa: F401
+    ChaosPlan, KEY_PATH, SERVING_SITES, TRAINER_SITES, compose,
+    plan_digest, uncovered_sites,
+)
+from nanorlhf_tpu.chaos.runner import (  # noqa: F401
+    SOAKS, SoakReport, soak_serving, soak_trainer,
+)
+from nanorlhf_tpu.chaos.shrink import repro_command, shrink  # noqa: F401

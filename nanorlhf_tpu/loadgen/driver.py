@@ -45,6 +45,7 @@ import urllib.request
 from typing import Optional
 
 from nanorlhf_tpu.analysis.lockorder import make_lock
+from nanorlhf_tpu.resilience.faults import InjectedFault
 from nanorlhf_tpu.loadgen.workload import (
     KEY_PATH, WorkloadSpec, sample_requests, spec_digest,
 )
@@ -92,13 +93,18 @@ class TrafficDriver:
     sequence — CI runs the same replayable workload, just denser."""
 
     def __init__(self, *, engine=None, base_url: Optional[str] = None,
-                 latency=None, lineage=None, tracer=None,
+                 latency=None, lineage=None, tracer=None, faults=None,
                  stream_timeout_s: float = 120.0, time_scale: float = 1.0):
         if (engine is None) == (base_url is None):
             raise ValueError(
                 "exactly one of engine= (in-process) or base_url= (HTTP) "
                 "selects the target")
         self._engine = engine
+        # gw.disconnect for the in-process target: the driver IS the
+        # client, so a fire makes THIS client vanish mid-stream and call
+        # engine.cancel — the same page-release path the gateway drives
+        # for HTTP clients (where the site is armed on the gateway side)
+        self._faults = faults
         self._base_url = base_url.rstrip("/") if base_url else None
         self._hub = latency if (latency is not None
                                 and latency.enabled) else None
@@ -257,6 +263,14 @@ class TrafficDriver:
             if n == 0:
                 ttft = time.perf_counter() - t_send
             n += 1
+            if self._disconnect_fires():
+                # this client vanishes mid-stream: tell the engine so the
+                # row stops decoding and its KV pages are released
+                self._engine.cancel(req)
+                return RequestRecord(
+                    index=rq.index, t_offset=rq.t_offset, outcome="error",
+                    reason="disconnect", ttft_s=ttft,
+                    total_s=time.perf_counter() - t_send, tokens=n)
         if n == 0:
             # an admitted request whose stream ended with zero tokens:
             # the engine aborted it (pool shed) or the stream timed out
@@ -293,6 +307,7 @@ class TrafficDriver:
                                  outcome="error", reason=f"http_{e.code}")
         ttft = None
         n = 0
+        saw_done = False
         with resp:
             for line in resp:
                 line = line.strip()
@@ -304,13 +319,32 @@ class TrafficDriver:
                         ttft = time.perf_counter() - t_send
                     n += 1
                 if obj.get("done"):
+                    saw_done = True
                     break
         if n == 0:
             return RequestRecord(index=rq.index, t_offset=rq.t_offset,
                                  outcome="shed", reason="engine_abort")
+        if not saw_done:
+            # the stream ended without the final done line — the gateway
+            # aborted it (its gw.disconnect site, or a server-side write
+            # failure); an unfinished stream must not count as goodput
+            return RequestRecord(
+                index=rq.index, t_offset=rq.t_offset, outcome="error",
+                reason="disconnect", ttft_s=ttft,
+                total_s=time.perf_counter() - t_send, tokens=n)
         return RequestRecord(
             index=rq.index, t_offset=rq.t_offset, outcome="completed",
             ttft_s=ttft, total_s=time.perf_counter() - t_send, tokens=n)
+
+    def _disconnect_fires(self) -> bool:
+        """True when the gw.disconnect site fires for this client (any
+        action — a raising schedule is the same vanished client)."""
+        if self._faults is None:
+            return False
+        try:
+            return self._faults.fire("gw.disconnect") is not None
+        except InjectedFault:
+            return True
 
     # ------------------------------------------------------------- #
     # observability

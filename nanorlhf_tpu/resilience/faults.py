@@ -61,6 +61,20 @@ envs/rollout.py — `worker=I` selects the episode index):
                    absorbs it into an error-text observation; the episode
                    continues, never a dead rollout
 
+Serving-path points (wired in serving/gateway.py's streaming response
+loop and loadgen/driver.py's in-process client):
+
+    gw.disconnect  the client vanishes mid-stream (default action "drop") —
+                   the gateway/driver must cancel the request so its KV
+                   pages are released and in-flight counters decremented
+
+Storage-integrity points (wired in trainer/checkpoint.py):
+
+    ckpt.corrupt   the checkpoint selected for restore reads back
+                   corrupt/torn (default action "tear") — restore falls
+                   back to the newest EARLIER intact checkpoint instead of
+                   failing the run, counting `resilience/ckpt_fallbacks`
+
 Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
 by ";" or whitespace):
 
@@ -104,7 +118,7 @@ import os
 import threading
 
 from nanorlhf_tpu.analysis.lockorder import make_lock
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -133,6 +147,12 @@ INJECTION_POINTS = frozenset({
     # driver absorbs it as an error-text observation)
     "env.hang",
     "env.crash",
+    # serving-path site (serving/gateway.py response loop + loadgen/driver.py
+    # in-process client): the client vanishes mid-stream
+    "gw.disconnect",
+    # storage-integrity site (trainer/checkpoint.py): the restored
+    # checkpoint reads back corrupt/torn
+    "ckpt.corrupt",
 })
 
 ACTIONS = ("raise", "nan", "hang", "delay",
@@ -149,6 +169,8 @@ _DEFAULT_ACTIONS = {
     "net.duplicate": "duplicate",
     "net.tear": "tear",
     "env.hang": "delay",
+    "gw.disconnect": "drop",
+    "ckpt.corrupt": "tear",
 }
 
 
@@ -244,11 +266,17 @@ class FaultInjector:
     with "hang" it returns "hang", and with "delay" it returns
     "delay:<seconds>" for the fleet worker loop to stall on. Returns None
     when nothing fires — the disarmed fast path is one dict lookup, so
-    production code leaves the calls in unconditionally."""
+    production code leaves the calls in unconditionally.
+
+    `on_fire(point, worker, outcome)` — when set — observes every fire
+    (outcome is the action string, or "raise" for raising fires) AFTER the
+    registry lock is released, so the hook may take other declared locks
+    (the chaos harness journals fires into the lineage ledger here)."""
 
     def __init__(self, schedules: Optional[list[FaultSchedule]] = None):
         self._lock = make_lock("resilience.faults")
         self._by_point: dict[str, list[FaultSchedule]] = {}
+        self.on_fire: Optional[Callable[[str, Optional[int], str], None]] = None
         for s in schedules or []:
             self._by_point.setdefault(s.point, []).append(s)
 
@@ -267,6 +295,7 @@ class FaultInjector:
         schedules = self._by_point.get(point)
         if not schedules:
             return None
+        fired: Optional[tuple[str, str]] = None  # (outcome tag, detail)
         with self._lock:
             for s in schedules:
                 if s.worker is not None and s.worker != worker:
@@ -276,12 +305,25 @@ class FaultInjector:
                         detail = f"call {s.calls}" + (
                             f" worker {worker}" if worker is not None else ""
                         )
-                        raise InjectedFault(point, detail=detail)
-                    if s.action in ("delay", "partition"):
+                        fired = ("raise", detail)
+                    elif s.action in ("delay", "partition"):
                         # these carry their duration parameter through
-                        return f"{s.action}:{s.delay}"
-                    return s.action
-        return None
+                        fired = (f"{s.action}:{s.delay}", "")
+                    else:
+                        fired = (s.action, "")
+                    break
+        if fired is None:
+            return None
+        outcome, detail = fired
+        hook = self.on_fire
+        if hook is not None:
+            try:
+                hook(point, worker, outcome)
+            except Exception:
+                pass  # observation must never change fault semantics
+        if outcome == "raise":
+            raise InjectedFault(point, detail=detail)
+        return outcome
 
     def stats(self) -> dict:
         """{point: {"calls": n, "fires": m}} — test/debug introspection."""

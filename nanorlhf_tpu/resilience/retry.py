@@ -8,6 +8,12 @@ import random
 import time
 from typing import Callable, Optional, Type
 
+# Default jitter stream for callers that don't pass their own rng. A
+# module-level seeded instance (not the global `random` module): the jitter
+# draw must never depend on whatever unrelated code did to global random
+# state, and a fresh process replays the same delay sequence.
+_JITTER_RNG = random.Random(0x6A177E12)
+
 
 def retry_with_backoff(
     fn: Callable,
@@ -49,10 +55,12 @@ def backoff_delay(attempt: int, base: float, cap: float,
     restarted producers) that failed on the same cause at the same moment
     would otherwise all sleep EXACTLY base·2^k and stampede the weight
     store / checkpoint filesystem in lockstep on every retry wave. Callers
-    that need determinism pass a seeded `random.Random`; the default draws
-    from the module PRNG (jitter=0.0, the default, stays bit-stable)."""
+    that need per-caller determinism pass a seeded `random.Random`; the
+    default draws from a module-level SEEDED stream (never the global
+    `random` module, whose state any unrelated code may have perturbed), so
+    the default delay sequence is identical in every fresh process."""
     delay = min(cap, base * (2 ** max(0, attempt)))
     if jitter > 0.0 and delay > 0.0:
-        draw = (rng.random() if rng is not None else random.random())
+        draw = (rng if rng is not None else _JITTER_RNG).random()
         delay *= 1.0 + jitter * (2.0 * draw - 1.0)
     return min(cap, delay)

@@ -192,6 +192,7 @@ class ServingRequest:
     t_submit: float
     out_q: "queue.Queue" = field(default_factory=queue.Queue)
     n_emitted: int = 0
+    cancelled: bool = False       # set by cancel(); loop reaps the row
 
 
 class ServingEngine:
@@ -272,12 +273,12 @@ class ServingEngine:
         self._running = True
         self._ids = itertools.count()
         self._counters = {"requests": 0, "admitted": 0, "shed": 0,
-                          "completed": 0}
+                          "completed": 0, "cancelled": 0}
         # per-cause shed counters (serving/shed_total{reason=...}):
         # pre-seeded so every reason exports a 0 row from the first
         # scrape — dashboards can alert on rate() without init gaps
         self._shed_reasons = {"queue_full": 0, "slo_ttft_p95": 0,
-                              "closed": 0, "pool": 0}
+                              "closed": 0, "pool": 0, "disconnect": 0}
         self._dispatch_tokens = 0
         self._it_prev = 0
         self._thread = threading.Thread(target=self._loop,
@@ -330,6 +331,32 @@ class ServingEngine:
             return "slo_ttft_p95"
         return None
 
+    def cancel(self, req: ServingRequest) -> None:
+        """The client vanished mid-stream (`gw.disconnect`): stop decoding
+        for this request and free its resources — a dead socket must not
+        keep a row decoding or pin its KV pages. Still-pending requests
+        are shed immediately (reason "disconnect"); an admitted row is
+        reaped by the loop thread — which owns the block table and radix
+        refcounts — on its next iteration, counting into `cancelled`
+        (admitted == completed + cancelled at quiescence). Idempotent."""
+        was_pending = False
+        with self._cond:
+            if req.cancelled:
+                return
+            req.cancelled = True
+            try:
+                self._pending.remove(req)
+                was_pending = True
+            except ValueError:
+                pass
+            if was_pending:
+                self._counters["shed"] += 1
+                self._shed_reasons["disconnect"] = (
+                    self._shed_reasons.get("disconnect", 0) + 1)
+            self._cond.notify_all()
+        if was_pending:
+            req.out_q.put(None)
+
     def stream(self, req: ServingRequest, timeout: float = 120.0):
         """Yield the request's tokens as they land; ends at the `None`
         sentinel (or on `timeout` seconds of silence)."""
@@ -364,6 +391,7 @@ class ServingEngine:
                 self._n_active += len(admits)
             for r, req in admits:
                 self._admit(r, req)
+            self._reap_cancelled()
             if all(o is None for o in self._owner):
                 continue
             t0 = time.perf_counter()
@@ -439,6 +467,27 @@ class ServingEngine:
         req.out_q.put(int(tok0))
         req.n_emitted = 1
 
+    def _reap_cancelled(self):
+        """Loop-thread only: free rows whose owner was cancelled. Forcing
+        the done flag makes the jitted chunk skip the row; the page
+        release mirrors _deliver's completion path exactly, so a
+        disconnect can never leak what a completion would have freed."""
+        for r in range(self.rows):
+            req = self._owner[r]
+            if req is None or not req.cancelled:
+                continue
+            self._radix.release(self._table[r])
+            self._table[r] = self.num_pages
+            self._owner[r] = None
+            s = list(self._state)
+            s[4] = s[4].at[r].set(True)
+            self._state = tuple(s)
+            req.out_q.put(None)
+            with self._cond:
+                self._counters["cancelled"] += 1
+                self._n_active -= 1
+                self._cond.notify_all()
+
     def _deliver(self, t_chunk0: float):
         state = self._state
         done_h = np.asarray(state[4])
@@ -493,6 +542,7 @@ class ServingEngine:
             "serving/admitted": c["admitted"],
             "serving/shed": c["shed"],
             "serving/completed": c["completed"],
+            "serving/cancelled": c["cancelled"],
             "serving/pending": pending,
             "serving/active": active,
             "serving/prefix_hit_tokens": snap["hit_tokens"],

@@ -34,6 +34,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from nanorlhf_tpu.resilience.faults import InjectedFault
 from nanorlhf_tpu.telemetry.exporter import (
     render_prometheus, render_prometheus_histograms,
 )
@@ -51,14 +52,21 @@ _RETRY_AFTER = {"queue_full": 1, "slo_ttft_p95": 5, "closed": 30}
 class ServingGateway:
     """HTTP front for one ServingEngine. `close()` stops the listener
     only — the engine has its own lifecycle (the caller that built it
-    closes it)."""
+    closes it).
 
-    def __init__(self, engine, port: int = -1, host: str = "127.0.0.1"):
+    `faults` arms the `gw.disconnect` site (docs/RESILIENCE.md): a fire
+    mid-stream simulates the client's socket vanishing, driving the same
+    `engine.cancel()` path a real write failure takes — the row's KV
+    pages are released and in-flight counters decremented either way."""
+
+    def __init__(self, engine, port: int = -1, host: str = "127.0.0.1",
+                 faults=None):
         if host not in _LOOPBACK:
             raise ValueError(
                 f"gateway binds loopback only until listener auth lands "
                 f"(ROADMAP item 2, docs/FLEET.md); got host {host!r}")
         self.engine = engine
+        self._faults = faults
         self.enabled = bool(port)
         self.host = host
         self.port = 0
@@ -131,12 +139,23 @@ class ServingGateway:
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     count = 0
-                    for tok in gw.engine.stream(req):
-                        self._chunk(json.dumps({"token": tok}) + "\n")
-                        count += 1
-                    self._chunk(json.dumps({"done": True, "n": count})
-                                + "\n")
-                    self.wfile.write(b"0\r\n\r\n")
+                    try:
+                        for tok in gw.engine.stream(req):
+                            if gw._disconnect_fires():
+                                raise ConnectionResetError(
+                                    "injected client disconnect")
+                            self._chunk(json.dumps({"token": tok}) + "\n")
+                            count += 1
+                        self._chunk(json.dumps({"done": True, "n": count})
+                                    + "\n")
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        # the client vanished mid-stream (gw.disconnect, or
+                        # a real broken pipe): stop decoding and free the
+                        # row — a dead socket must not pin KV pages or
+                        # in-flight counters
+                        gw.engine.cancel(req)
+                        self.close_connection = True
                     return
                 toks = list(gw.engine.stream(req))
                 self._write(200, "application/json", json.dumps(
@@ -175,6 +194,16 @@ class ServingGateway:
     # ----------------------------------------------------------------- #
     # endpoint bodies (HTTP threads; engine accessors are thread-safe)
     # ----------------------------------------------------------------- #
+
+    def _disconnect_fires(self) -> bool:
+        """True when the gw.disconnect site fires (any action — a raising
+        schedule is the same vanished client as a returning one here)."""
+        if self._faults is None:
+            return False
+        try:
+            return self._faults.fire("gw.disconnect") is not None
+        except InjectedFault:
+            return True
 
     def _metrics(self) -> tuple:
         text = render_prometheus(self.engine.metrics())

@@ -266,6 +266,32 @@ class LineageLedger:
             step=step, row=row, **fields,
         )
 
+    def fault(self, *, point=None, worker=None, action=None, **fields) -> int:
+        """One armed fault site firing (the chaos harness hooks
+        FaultInjector.on_fire here): index-less, so the offline
+        `inspect_run --chaos` timeline rebuilds from the ledger alone."""
+        return self.event(
+            "fault", None, point=point, worker=worker, action=action,
+            **fields,
+        )
+
+    def chaos_run(self, *, seed=None, spec=None, spec_digest=None,
+                  path=None, key_path=None, **fields) -> int:
+        """Chaos soak header: the composed spec + its derivation, enough
+        to replay the identical run (`nanorlhf_tpu/chaos/`)."""
+        return self.event(
+            "chaos_run", None, seed=seed, spec=spec,
+            spec_digest=spec_digest, path=path, key_path=key_path, **fields,
+        )
+
+    def chaos_audit(self, *, name=None, ok=None, detail=None,
+                    checked=None, **fields) -> int:
+        """One run-invariant auditor's verdict (chaos/auditors.py)."""
+        return self.event(
+            "chaos_audit", None, name=name, ok=ok, detail=detail,
+            checked=checked, **fields,
+        )
+
     def note_sample(self, rollout_index: int, *, step=None, score=None,
                     response_chars=None, worker_id=None, kept=None):
         """Feed the last-N ring behind /statusz's `recent` list. Summaries

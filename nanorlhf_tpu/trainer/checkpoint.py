@@ -22,6 +22,7 @@ import shutil
 import jax
 import numpy as np
 
+from nanorlhf_tpu.resilience.faults import InjectedFault
 from nanorlhf_tpu.resilience.retry import retry_with_backoff
 
 
@@ -40,6 +41,12 @@ class CheckpointManager:
         self.io_retries = io_retries
         self.retry_backoff = retry_backoff
         self.retry_count = 0
+        # restore() falls back to older intact checkpoints when the
+        # requested one is corrupt/torn (ckpt.corrupt site): fallback_count
+        # feeds resilience/ckpt_fallbacks, last_restored_step tells the
+        # resume path which step actually loaded.
+        self.fallback_count = 0
+        self.last_restored_step: int | None = None
         self._faults = faults
         # telemetry.SpanTracer (docs/OBSERVABILITY.md): save/restore get
         # spans on a dedicated "ckpt" track — checkpoint I/O stalls are a
@@ -245,7 +252,7 @@ class CheckpointManager:
             else:
                 break  # everything is protected
 
-    def restore(self, step: int, like):
+    def restore(self, step: int, like, fallback: bool = True):
         """Restore the pytree saved at `step`, matching the structure/shardings
         of `like` (pass {"params": params_template, ...}).
 
@@ -266,22 +273,68 @@ class CheckpointManager:
           and donating one into the jitted update (which every training
           step after resume does) segfaults the CPU client — observed as a
           hard crash one-to-two updates after resume, serial and
-          orchestrated alike."""
+          orchestrated alike.
+
+        Corrupt/torn checkpoints (the `ckpt.corrupt` site, or an organic
+        read failure that survives every retry) do not fail the run: with
+        `fallback=True` (default) restore walks back to the newest EARLIER
+        committed checkpoint, bumping `fallback_count`
+        (resilience/ckpt_fallbacks) once per skipped step and recording the
+        step that actually loaded in `last_restored_step` — resume callers
+        must adopt it (and truncate the corrupt newer trajectory) or their
+        trainer_state read diverges from the restored tree."""
         self.wait()
-        path = os.path.join(self.output_dir, f"checkpoint-{step}", "tree")
+        candidates = [step]
+        if fallback:
+            candidates += [
+                s for s in (
+                    int(d.rsplit("-", 1)[1]) for d in reversed(self._existing())
+                ) if s < step
+            ]
+        last_exc: Exception | None = None
+        restored = None
+        for i, cand in enumerate(candidates):
+            path = os.path.join(self.output_dir, f"checkpoint-{cand}", "tree")
 
-        def attempt():
+            def attempt(path=path):
+                if self._faults is not None:
+                    self._faults.fire("ckpt.restore")
+                return self._ckptr.restore(path, item=like)
+
+            def on_retry(_attempt, _exc):
+                self.retry_count += 1
+
+            # ckpt.corrupt models the read returning garbage, not erroring —
+            # retrying the same bytes can't help, so it fires once per
+            # candidate OUTSIDE the retry loop and sends us straight to the
+            # next older checkpoint
+            corrupt = None
             if self._faults is not None:
-                self._faults.fire("ckpt.restore")
-            return self._ckptr.restore(path, item=like)
-
-        def on_retry(_attempt, _exc):
-            self.retry_count += 1
-
-        with self._span("ckpt.restore", step=step):
-            restored = retry_with_backoff(
-                attempt, attempts=self.io_retries + 1,
-                backoff_base=self.retry_backoff, on_retry=on_retry,
+                try:
+                    corrupt = self._faults.fire("ckpt.corrupt")
+                except InjectedFault as e:
+                    corrupt = e
+            if corrupt is None:
+                try:
+                    with self._span("ckpt.restore", step=cand):
+                        restored = retry_with_backoff(
+                            attempt, attempts=self.io_retries + 1,
+                            backoff_base=self.retry_backoff, on_retry=on_retry,
+                        )
+                except Exception as e:
+                    last_exc = e
+            if restored is not None:
+                self.fallback_count += i
+                self.last_restored_step = cand
+                if i:
+                    print(f"[checkpoint] checkpoint {step} corrupt/unreadable "
+                          f"— fell back to checkpoint {cand}")
+                break
+        if restored is None:
+            if last_exc is not None:
+                raise last_exc
+            raise InjectedFault(
+                "ckpt.corrupt", detail=f"no intact checkpoint at or below {step}"
             )
         import jax.numpy as jnp
         from jax.sharding import SingleDeviceSharding

@@ -2327,6 +2327,7 @@ class RLTrainer:
                 "resilience/rollbacks": float(self.sentinel.rollbacks),
                 "resilience/degraded_mode": float(self.watchdog.degraded),
                 "resilience/ckpt_retries": float(self.ckpt.retry_count),
+                "resilience/ckpt_fallbacks": float(self.ckpt.fallback_count),
             })
             # memory series (docs/METRICS.md, docs/FUSED_LOGPROB.md):
             # peak_bytes_in_use from the backend (0 on CPU), plus the
@@ -2708,6 +2709,13 @@ class RLTrainer:
             self._orchestrator.close()
             self._orchestrator = None
         restored = self.ckpt.restore(step, self._restore_template())
+        if self.ckpt.last_restored_step is not None and \
+                self.ckpt.last_restored_step != step:
+            # the requested checkpoint was corrupt/torn and restore fell
+            # back to an older intact one (docs/RESILIENCE.md ckpt.corrupt)
+            # — adopt the step that actually loaded so trainer_state and
+            # truncation below track the restored tree
+            step = self.ckpt.last_restored_step
         if latest is not None and step < latest:
             # resuming an earlier step abandons the newer trajectory
             self.ckpt.truncate_after(step)

@@ -228,6 +228,59 @@ def test_fault_site_cross_check_both_directions(tmp_path):
     assert any("ghost.site" in f.detail for f in findings)
 
 
+def test_invariant_cross_check_both_directions(tmp_path):
+    proj = _proj(tmp_path, {
+        "docs/RESILIENCE.md": """
+            | invariant | meaning |
+            |---|---|
+            | `chaos.kv_page_leak` | checked and documented |
+            | `chaos.ghost_rule` | documented, never checked |
+        """,
+        "nanorlhf_tpu/chaos/a.py": """
+            INVARIANTS = ("chaos.kv_page_leak", "chaos.rogue_rule")
+        """,
+    })
+    findings = registry.run(proj)
+    rules = _rules(findings)
+    assert "registry.invariant-undocumented" in rules    # chaos.rogue_rule
+    assert "registry.invariant-unchecked" in rules       # chaos.ghost_rule
+    assert any(f.rule == "registry.invariant-undocumented"
+               and "chaos.rogue_rule" in f.detail for f in findings)
+    assert any(f.rule == "registry.invariant-unchecked"
+               and "chaos.ghost_rule" in f.detail for f in findings)
+
+
+def test_invariant_strings_outside_chaos_scope_ignored(tmp_path):
+    # the chaos.* string grammar only counts inside nanorlhf_tpu/chaos/
+    # — a log message elsewhere must not become a registry obligation
+    proj = _proj(tmp_path, {
+        "docs/RESILIENCE.md": "",
+        "nanorlhf_tpu/telemetry/b.py": """
+            MSG = "chaos.not_an_auditor"
+        """,
+    })
+    findings = registry.run(proj)
+    assert not any(f.rule.startswith("registry.invariant")
+                   for f in findings)
+
+
+def test_parse_invariant_tables_grammar():
+    # same table grammar as the fault-site registry, selected by the
+    # header's first cell; non-matching tokens and fault tables ignored
+    text = textwrap.dedent("""
+        | point | effect |
+        |---|---|
+        | `ckpt.save` | a fault site, not an invariant |
+
+        | Invariant | meaning |
+        |---|---|
+        | `chaos.worker_leak` | counted |
+        | not backticked | ignored |
+        | `Chaos.Uppercase` | ignored: bad grammar |
+    """)
+    assert registry.parse_invariant_tables(text) == {"chaos.worker_leak"}
+
+
 def test_metric_doc_cross_check(tmp_path):
     proj = _proj(tmp_path, {
         "docs/METRICS.md": """

@@ -13,7 +13,9 @@ step under NANORLHF_LOCK_CHECK=1, so every engine/radix lock acquisition
 is order-checked live.
 """
 
+import http.client
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -475,3 +477,183 @@ def test_grpo_update_with_prefix_cache(tmp_path):
     # /statusz carries the inspectable tree snapshot
     sz = tr._statusz()
     assert sz["prefix_cache"]["lookups"] > 0
+
+# --------------------------------------------------------------------- #
+# gw.disconnect: clients vanishing mid-stream (docs/RESILIENCE.md §chaos)
+# --------------------------------------------------------------------- #
+
+def _chaos_engine(tiny, **kw):
+    from nanorlhf_tpu.serving.engine import ServingEngine
+    config, params = tiny
+    kw.setdefault("eos_token_id", EOS)
+    kw.setdefault("pad_token_id", PAD)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prompt_len", 12)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("rows", 2)
+    return ServingEngine(params, config, **kw)
+
+
+def _quiesce(eng, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = eng.snapshot()
+        if snap["pending"] == 0 and snap["active"] == 0:
+            return snap
+        time.sleep(0.01)
+    raise AssertionError("engine never drained")
+
+
+def _full_budget_prompt(eng):
+    """A prompt whose natural greedy stream runs the whole token budget
+    without hitting EOS — the engine is deterministic given (params,
+    seed), so probing is stable, and cancelling such a stream mid-flight
+    really abandons a live decoding row."""
+    for cand in ([5, 6, 7, 8, 9, 10], [11, 12, 13], [20, 21, 22, 23],
+                 [30, 31], [40, 41, 42, 43, 44], [50, 51, 52]):
+        req, reason = eng.submit(cand, greedy=True)
+        assert reason is None
+        toks = list(eng.stream(req))
+        if len(toks) == eng.max_new_tokens and toks[-1] != EOS:
+            _quiesce(eng)
+            return cand
+    raise AssertionError("no probe prompt ran the full budget")
+
+
+def test_engine_cancel_active_releases_pages(tiny):
+    """Cancelling an admitted stream reaps the row: the stream ends at
+    the sentinel, the `cancelled` counter balances admission, and every
+    abandoned KV page returns to free/radix-cached (no leak, nothing
+    left shared). Pins the precondition the chaos kv_page_leak auditor
+    relies on."""
+    eng = _chaos_engine(tiny)
+    try:
+        victim = _full_budget_prompt(eng)
+        base = eng.snapshot()["counters"]
+
+        req, reason = eng.submit(victim, greedy=True)
+        assert reason is None
+        it = eng.stream(req)
+        next(it)                       # live: the row is decoding
+        eng.cancel(req)                # client vanished mid-stream
+        rest = list(it)                # sentinel lands, stream terminates
+        assert len(rest) < eng.max_new_tokens
+
+        snap = _quiesce(eng)
+        c = snap["counters"]
+        assert c["cancelled"] == base["cancelled"] + 1
+        assert c["completed"] == base["completed"]
+        assert c["admitted"] == c["completed"] + c["cancelled"]
+        radix = snap["prefix_cache"]
+        assert (radix["free_pages"] + radix["cached_pages"]
+                == snap["num_pages"])
+        assert radix["shared_pages"] == 0
+        # the device block table holds no live rows either
+        assert int((np.asarray(eng._table) < eng.num_pages).sum()) == 0
+
+        eng.cancel(req)                # idempotent: reaped requests no-op
+        assert eng.snapshot()["counters"]["cancelled"] == c["cancelled"]
+
+        # the engine still serves: same prompt completes bit-identically
+        req2, reason = eng.submit(victim, greedy=True)
+        assert reason is None
+        assert len(list(eng.stream(req2))) == eng.max_new_tokens
+    finally:
+        eng.close()
+
+
+def test_engine_cancel_pending_sheds_disconnect(tiny):
+    """Cancelling a still-pending request sheds it immediately (reason
+    "disconnect", never admitted) and its stream ends at the sentinel
+    without blocking."""
+    eng = _chaos_engine(tiny)
+    try:
+        victim = _full_budget_prompt(eng)
+        base = eng.snapshot()["counters"]
+        # bury the victim deep in the pending queue: with 2 rows and 6
+        # submissions, the LAST one needs two full generation rounds to
+        # reach admission, so the immediate cancel is guaranteed to find
+        # it still pending (no race against the admission loop)
+        reqs = [eng.submit(victim, greedy=True)[0] for _ in range(6)]
+        assert all(r is not None for r in reqs)
+        eng.cancel(reqs[-1])
+        assert list(eng.stream(reqs[-1])) == []   # sentinel, no tokens
+        for r in reqs[:-1]:
+            list(eng.stream(r))
+        snap = _quiesce(eng)
+        assert snap["shed_reasons"].get("disconnect", 0) == 1
+        assert snap["counters"]["admitted"] == base["admitted"] + 5
+        m = eng.metrics()
+        assert m['serving/shed_total{reason="disconnect"}'] == 1
+        assert m["serving/cancelled"] == 0   # never admitted → not reaped
+    finally:
+        eng.close()
+
+
+def test_gateway_disconnect_fault_mid_stream(tiny):
+    """End-to-end gw.disconnect through the HTTP gateway: the injected
+    fire aborts the chunked NDJSON stream mid-flight (client sees a
+    truncated body with no done record), the engine reaps the row, and
+    at quiescence the counters balance and the page pool is whole — then
+    the next request completes normally."""
+    from nanorlhf_tpu.resilience.faults import FaultInjector
+    from nanorlhf_tpu.serving.gateway import ServingGateway
+
+    eng = _chaos_engine(tiny)
+    inj = FaultInjector.from_spec("gw.disconnect:every=3,count=4")
+    gw = ServingGateway(eng, port=-1, faults=inj)
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        victim = _full_budget_prompt(eng)
+        # the engine decodes independently of the HTTP consumer, so by
+        # the time the handler's fire aborts the stream the request may
+        # already have completed (cancel is then the idempotent no-op);
+        # count cancel() invocations to pin the gateway wiring without
+        # racing the decode loop
+        cancels = []
+        orig_cancel = eng.cancel
+        eng.cancel = lambda req: (cancels.append(req.request_id),
+                                  orig_cancel(req))[1]
+        truncated = 0
+        for _ in range(4):
+            resp = _post(base, {"tokens": victim, "greedy": True,
+                                "stream": True})
+            try:
+                body = resp.read()
+            except http.client.IncompleteRead as e:
+                body = e.partial
+            except (ConnectionError, OSError):
+                body = b""
+            lines = []
+            for ln in body.decode(errors="replace").splitlines():
+                try:
+                    lines.append(json.loads(ln))
+                except ValueError:
+                    pass
+            if not (lines and lines[-1].get("done")):
+                truncated += 1
+
+        stats = inj.stats()["gw.disconnect"]
+        assert stats["fires"] >= 1
+        assert truncated >= 1          # at least one stream was severed
+        assert len(cancels) == truncated  # every severed stream cancelled
+
+        snap = _quiesce(eng)
+        c = snap["counters"]
+        assert c["admitted"] == c["completed"] + c["cancelled"]
+        radix = snap["prefix_cache"]   # abandoned pages all came back
+        assert (radix["free_pages"] + radix["cached_pages"]
+                == snap["num_pages"])
+        assert radix["shared_pages"] == 0
+
+        # injector exhausted (count=4): service is back to normal
+        inj_left = stats["fires"]
+        resp = _post(base, {"tokens": victim, "greedy": True,
+                            "stream": True})
+        lines = [json.loads(ln) for ln in resp.read().decode().splitlines()]
+        assert lines[-1]["done"] is True
+        assert len(lines) - 1 == eng.max_new_tokens
+        assert inj.stats()["gw.disconnect"]["fires"] == inj_left == 4
+    finally:
+        gw.close()
+        eng.close()

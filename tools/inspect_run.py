@@ -33,6 +33,13 @@ scores from `sample`/`reward` events the ledger already carries).
                                                       # scale decisions from
                                                       # `traffic`/`autoscale`
                                                       # events alone
+  python tools/inspect_run.py RUN_DIR --chaos         # chaos soak replay:
+                                                      # composed spec, fault-
+                                                      # fire timeline, and
+                                                      # journaled auditor
+                                                      # verdicts from
+                                                      # `chaos_run`/`fault`/
+                                                      # `chaos_audit` events
 
 RUN_DIR is the trainer's output_dir (containing `lineage/`) or the lineage
 directory itself; for --serving it is a saved /statusz JSON (curl the
@@ -216,6 +223,69 @@ def _print_traffic(rep: dict) -> None:
                   f"{d.get('queue_depth')}")
 
 
+def chaos_report(events) -> dict:
+    """Rebuild a chaos soak's story from the ledger ALONE (docs/
+    RESILIENCE.md §chaos): the `chaos_run` header (seed + spec + key
+    path — the complete replay recipe), the fault-fire timeline in
+    soak-relative order, per-site fire counts, and the `chaos_audit`
+    verdicts the runner journaled after teardown."""
+    runs = [ev for ev in events if ev.get("type") == "chaos_run"]
+    fires = [ev for ev in events if ev.get("type") == "fault"]
+    audits = [ev for ev in events if ev.get("type") == "chaos_audit"]
+    per_site: dict = {}
+    for ev in fires:
+        p = ev.get("point") or "unknown"
+        per_site[p] = per_site.get(p, 0) + 1
+    return {
+        "runs": [{k: ev.get(k)
+                  for k in ("seed", "spec", "spec_digest", "path",
+                            "key_path")}
+                 for ev in runs],
+        "fires": [{k: ev.get(k)
+                   for k in ("point", "worker", "action", "t_offset")}
+                  for ev in fires],
+        "fires_by_site": per_site,
+        "audits": [{k: ev.get(k)
+                    for k in ("name", "ok", "detail", "checked")}
+                   for ev in audits],
+        "ok": (all(a.get("ok") for a in audits) if audits else None),
+    }
+
+
+def _print_chaos(rep: dict) -> None:
+    if not rep["runs"] and not rep["audits"]:
+        print("no `chaos_run`/`chaos_audit` events in the ledger (not a "
+              "chaos soak, or lineage was off)")
+        return
+    for run in rep["runs"]:
+        print(f"chaos run: path={run.get('path')} seed={run.get('seed')} "
+              f"digest={run.get('spec_digest')}")
+        print(f"  spec: {run.get('spec') or '(empty)'}")
+        print(f"  key path: {run.get('key_path')}")
+    if rep["fires"]:
+        print(f"{len(rep['fires'])} fault fires:")
+        for f in rep["fires"]:
+            t = f.get("t_offset")
+            stamp = f"+{t:8.3f}s" if isinstance(t, (int, float)) else " " * 10
+            who = (f" worker {f['worker']}"
+                   if f.get("worker") is not None else "")
+            print(f"  {stamp}  {f.get('point'):<22s} "
+                  f"{f.get('action')}{who}")
+    else:
+        print("no fault fires recorded")
+    if rep["audits"]:
+        print("auditor verdicts:")
+        for a in rep["audits"]:
+            mark = "ok " if a.get("ok") else "FAIL"
+            extra = f" — {a['detail']}" if a.get("detail") else ""
+            print(f"  [{mark}] {a.get('name')} "
+                  f"(checked={a.get('checked')}){extra}")
+        print("verdict:", "PASS" if rep["ok"] else "FAIL")
+    else:
+        print("no journaled auditor verdicts (soak crashed before the "
+              "audit pass?)")
+
+
 def serving_report(path: str) -> dict:
     """Load a saved /statusz snapshot and pull out the serving engine and
     radix prefix-cache sections. Accepts either shape: the gateway's
@@ -383,6 +453,11 @@ def main():
                     help="offered-load/goodput/shed timeline + autoscale "
                          "decisions reconstructed from `traffic`/"
                          "`autoscale` events alone (docs/TRAFFIC.md)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos soak replay: composed spec, fault-fire "
+                         "timeline, and journaled auditor verdicts from "
+                         "`chaos_run`/`fault`/`chaos_audit` events alone "
+                         "(docs/RESILIENCE.md §chaos)")
     ap.add_argument("--serving", action="store_true",
                     help="serving engine + radix prefix-cache sections of "
                          "a saved /statusz snapshot (run_dir is the JSON "
@@ -447,6 +522,14 @@ def main():
             print(json.dumps(rep, sort_keys=True))
             return 0
         _print_traffic(rep)
+        return 0
+
+    if args.chaos:
+        rep = chaos_report(events)
+        if args.json:
+            print(json.dumps(rep, sort_keys=True))
+            return 0
+        _print_chaos(rep)
         return 0
 
     if args.turns:
