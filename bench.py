@@ -61,6 +61,14 @@ BENCH_SERVING (1: also run the radix prefix-cache A/B and report
 detail.serving — radix on vs off at equal resident batch on a >= 50%
 prompt-overlap corpus; acceptance prefix_hit_frac > 0.4 with strictly
 fewer dispatched prefill tokens, greedy bit-identical, docs/SERVING.md),
+BENCH_SESSION (1: also run the decode-session composition A/B and
+report detail.session — spec+radix combined vs each feature alone at
+equal resident batch on an 87.5%-overlap corpus, acceptance combined
+dispatch EVENTS strictly below min(each alone) with greedy output
+bit-identical 4-way and combined prefill tokens below spec-alone's,
+plus the chunked-prefill p95 inter-token-gap gate at <= 1.2x the
+no-long-prompt baseline on a live engine stream,
+docs/PAGED_CACHE.md §session),
 BENCH_ENV (1: also run the multi-turn environment A/B and report
 detail.env — 2-turn python-tool episodes vs the single-turn degenerate
 case at EQUAL resident batch, reporting turns/episode and the tool-stall
@@ -760,6 +768,176 @@ def _serving_check(jax) -> dict:
         "greedy_bit_identical": identical,
         "serving_check": "ok" if (
             identical and disp_on < disp_off and hit_frac > 0.4
+        ) else "MISMATCH",
+    }
+
+
+def _session_check(jax) -> dict:
+    """Decode-session composition A/B (ISSUE 18, docs/PAGED_CACHE.md
+    §session): two gates.
+
+    SPEC-UNDER-RADIX — the SAME queued scheduler at the SAME resident
+    batch on an 87.5%-overlap corpus (one σ-chain prompt repeated 8
+    times: the deterministic permutation machine makes every repeat's
+    greedy continuation identical, so after the first row finishes the
+    radix tree holds the exact text later admissions will generate and
+    the drafter seed covers it). Combined spec+radix must issue STRICTLY
+    fewer dispatch EVENTS (admission launches + decode/verify chunk
+    iterations) than either feature alone — events, not tokens, because
+    a verify dispatch carries k+1 tokens where plain decode carries one
+    (docs/DECODE_ANALYSIS.md §dispatch accounting); the token-
+    denominated half of the win (combined prefill tokens < spec-alone's)
+    is gated separately. Greedy output must be bit-identical across all
+    four corners.
+
+    CHUNKED PREFILL — client-observed p95 inter-token gap on a live
+    ServingEngine stream while long cold prompts admit mid-decode, with
+    `prefill_chunk` on, must stay within 1.2x the no-long-prompt
+    baseline (same engine, no interfering traffic). The unchunked column
+    is reported for contrast but not gated — it pays each long prompt's
+    whole suffix forward inside one gap. Client-side arrival timestamps,
+    not the hub's chunk-wall metric, because the admission stall happens
+    BETWEEN decode chunks and only the stream sees it. Gate with
+    BENCH_SESSION=0."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+    from nanorlhf_tpu.serving.radix import RadixCache
+
+    V, R, P, Tp, resp = 64, 2, 4, 12, 12
+    EOS, PAD = 3, 0
+    # the σ-chain needs an UNTIED lm_head: with tie_word_embeddings the
+    # unembedding is embed_tokensᵀ, logits collapse to token similarity
+    # and greedy re-emits the input token forever — a constant stream the
+    # unseeded drafter matches trivially, which voids the A/B
+    mcfg = dataclasses.replace(
+        ModelConfig.qwen2_tiny(vocab_size=V), tie_word_embeddings=False
+    )
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    # the deterministic permutation machine (as in _serving_check):
+    # zeroed layers + identity embedding + σ-chain lm_head make greedy
+    # generation follow σ from the last real token
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    for t in range(10, 50):
+        sigma[t] = t + 1
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    # 8 identical prompts: 7/8 = 87.5% overlap an earlier admission;
+    # chain start 30 → every row greedily emits 31..42
+    real = [9] * 6 + [30]
+    Q = 8
+    prompts = np.full((Q, Tp), PAD, np.int32)
+    prompts[:, Tp - len(real):] = real
+    ids, mask = jnp.asarray(prompts), jnp.asarray(prompts != PAD)
+    kw = dict(eos_token_id=EOS, pad_token_id=PAD)
+
+    def run(spec_k, cache):
+        sp = SamplingParams(greedy=True, max_tokens=resp, page_size=P,
+                            decode_rows=R, spec_k=spec_k)
+        pst: list = []
+        out = np.asarray(generate(
+            params, mcfg, ids, mask, jax.random.PRNGKey(0), sp,
+            paged_stats_out=pst, prefix_cache=cache, **kw))
+        return out, pst[-1]
+
+    out_plain, _ = run(0, None)
+    out_radix, st_radix = run(0, RadixCache())
+    out_spec, st_spec = run(3, None)
+    out_both, st_both = run(3, RadixCache())
+
+    identical = (np.array_equal(out_plain, out_radix)
+                 and np.array_equal(out_plain, out_spec)
+                 and np.array_equal(out_plain, out_both))
+    ev = {k: int(s["dispatch_events"]) for k, s in
+          (("radix", st_radix), ("spec", st_spec), ("both", st_both))}
+    pf = {k: int(s["prefill_token_dispatch"]) for k, s in
+          (("radix", st_radix), ("spec", st_spec), ("both", st_both))}
+    spec_radix = {
+        "queue_length": Q,
+        "decode_rows": R,
+        "overlap_frac": round((Q - 1) / Q, 3),
+        "dispatch_events_radix": ev["radix"],
+        "dispatch_events_spec": ev["spec"],
+        "dispatch_events_both": ev["both"],
+        "prefill_tokens_radix": pf["radix"],
+        "prefill_tokens_spec": pf["spec"],
+        "prefill_tokens_both": pf["both"],
+        "prefix_hit_tokens": int(st_both["prefix_hit_tokens"]),
+        "drafter_seed_window": st_both["session"]["features"][
+            "drafter_seed_window"],
+        "greedy_bit_identical": bool(identical),
+        "gate": "ok" if (
+            identical and ev["both"] < min(ev["radix"], ev["spec"])
+            and pf["both"] < pf["spec"]
+        ) else "MISMATCH",
+    }
+
+    # ---- chunked prefill: client-observed p95 inter-token gap -------- #
+    from nanorlhf_tpu.serving.engine import ServingEngine
+
+    Tp_l, MN, CH = 48, 24, 8
+    long_real = list(range(4, 52))                      # 48-token cold
+    victim_real = [9] * 3 + [10]
+
+    def gaps(prefill_chunk, n_long):
+        eng = ServingEngine(params, mcfg, eos_token_id=EOS,
+                            pad_token_id=PAD, page_size=P,
+                            prompt_len=Tp_l, max_new_tokens=MN, rows=R,
+                            sync_every=4, seed=0,
+                            prefill_chunk=prefill_chunk)
+        try:
+            # warm every compile path (victim admission, long-prompt
+            # suffix bucket / chunk forward, decode chunk) before timing
+            for warm in (victim_real, long_real):
+                wreq, _ = eng.submit(warm, greedy=True)
+                list(eng.stream(wreq))
+            req, _ = eng.submit(victim_real, greedy=True)
+            it = eng.stream(req)
+            next(it)
+            stamps = [time.perf_counter()]
+            submitted = 0
+            for _ in it:
+                stamps.append(time.perf_counter())
+                if submitted < n_long:                  # interfere mid-decode
+                    submitted += 1
+                    lreq, _ = eng.submit(long_real, greedy=True)
+            deltas = np.diff(stamps)
+            return float(np.quantile(deltas, 0.95)) if deltas.size else 0.0
+        finally:
+            eng.close()
+
+    p95_base = gaps(CH, 0)
+    p95_chunked = gaps(CH, 3)
+    p95_unchunked = gaps(0, 3)
+    ratio = p95_chunked / max(p95_base, 1e-9)
+    chunked = {
+        "prompt_len": Tp_l,
+        "prefill_chunk": CH,
+        "long_prompts": 3,
+        "p95_intertoken_s_baseline": round(p95_base, 5),
+        "p95_intertoken_s_chunked": round(p95_chunked, 5),
+        "p95_intertoken_s_unchunked": round(p95_unchunked, 5),
+        "p95_ratio_vs_baseline": round(ratio, 3),
+        "gate": "ok" if ratio <= 1.2 else "MISMATCH",
+    }
+    return {
+        "spec_under_radix": spec_radix,
+        "chunked_prefill": chunked,
+        "session_check": "ok" if (
+            spec_radix["gate"] == "ok" and chunked["gate"] == "ok"
         ) else "MISMATCH",
     }
 
@@ -1631,6 +1809,18 @@ def run_bench(jax, init_error):
             serving_detail = _serving_check(jax)
         except Exception as e:
             serving_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    session_detail = None
+    if os.environ.get("BENCH_SESSION", "1") == "1":
+        try:
+            # decode-session composition A/B (tiny model, any backend) —
+            # the ISSUE-18 gates: spec+radix combined < min(each alone)
+            # in dispatch events at equal resident batch on an
+            # 87.5%-overlap corpus, greedy bit-identical 4-way, and the
+            # chunked-prefill p95 inter-token gap within 1.2x the
+            # no-long-prompt baseline
+            session_detail = _session_check(jax)
+        except Exception as e:
+            session_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
     traffic_detail = None
     if os.environ.get("BENCH_TRAFFIC", "1") == "1":
         try:
@@ -1672,6 +1862,7 @@ def run_bench(jax, init_error):
         "spec_decode": spec_decode_detail,
         **({"paged": paged_detail} if paged_detail is not None else {}),
         **({"serving": serving_detail} if serving_detail is not None else {}),
+        **({"session": session_detail} if session_detail is not None else {}),
         **({"traffic": traffic_detail} if traffic_detail is not None else {}),
         **({"env": env_detail} if env_detail is not None else {}),
         "prompts_per_update": episodes_per_update,

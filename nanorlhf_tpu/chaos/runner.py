@@ -178,7 +178,8 @@ def soak_serving(run_dir: str, plan: ChaosPlan = None, *, seed: int = 0,
         "loadgen": driver.metrics(),
         "live_table_rows": [
             r for r in range(engine.rows)
-            if any(int(p) < engine.num_pages for p in engine._table[r])
+            if any(int(p) < engine.num_pages
+                   for p in engine.session.table_np[r])
         ],
         "leaked_threads": _leaked_threads(before),
         "leaked_procs": 0,

@@ -995,6 +995,7 @@ def decode_verify(
     lora_scale: float = 1.0,
     page_table=None,              # [B, nb] int32 (paged layout)
     page_size: int = 0,
+    want_logits: bool = True,
 ):
     """Batched k-token verification for speculative decode
     (sampler/speculative.py): one small-T causal forward over Tq = k+1
@@ -1012,6 +1013,11 @@ def decode_verify(
     (logits [B, Tq, V], new caches): logits[:, i] is the next-token
     distribution after consuming candidates 0..i, bit-matching a chain of
     `decode_step` calls over the same tokens on the CPU mesh (test-pinned).
+
+    `want_logits=False` skips the lm_head matmul and returns
+    (None, new caches) — the chunked-prefill path (sampler/paged/session.py)
+    runs every non-final prompt chunk purely for its KV writes, and at LLM
+    vocabularies the unread [B, Tq, V] projection would dominate the chunk.
     """
     B, Tq = tokens.shape
     # the logical width is the key_mask width — equal to the slab's T_max on
@@ -1031,4 +1037,6 @@ def decode_verify(
         cache_index=fill.astype(jnp.int32), lora_scale=lora_scale,
         verify_bounds=(start, fill.astype(jnp.int32)), paged=paged,
     )
+    if not want_logits:
+        return None, new_caches
     return _logits(config, params, x), new_caches

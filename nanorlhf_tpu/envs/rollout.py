@@ -52,7 +52,7 @@ from nanorlhf_tpu.core.model import init_paged_kv_cache
 from nanorlhf_tpu.envs.base import Environment
 from nanorlhf_tpu.sampler import generate
 from nanorlhf_tpu.sampler.paged.pages import blocks_per_row, init_page_state
-from nanorlhf_tpu.sampler.paged.scheduler import (
+from nanorlhf_tpu.sampler.paged.session import (
     _ADMIT_BASE,
     _admit_one,
     _alloc_jit,

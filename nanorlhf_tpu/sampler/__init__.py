@@ -1,9 +1,11 @@
-from nanorlhf_tpu.sampler.sampler import SamplingParams, generate, generate_tokens
+from nanorlhf_tpu.sampler.sampler import (
+    SamplingParams, compose_check, generate, generate_tokens,
+)
 from nanorlhf_tpu.sampler.speculative import generate_tokens_spec
 
 __all__ = [
-    "SamplingParams", "generate", "generate_tokens", "generate_tokens_spec",
-    "generate_tokens_queued",
+    "SamplingParams", "compose_check", "generate", "generate_tokens",
+    "generate_tokens_spec", "generate_tokens_queued",
 ]
 
 

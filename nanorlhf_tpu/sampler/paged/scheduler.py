@@ -5,26 +5,45 @@ until the slowest row finishes — a long-tail length distribution leaves most
 rows idle (emitting pads) for most of the loop. Compaction
 (sampler/compaction.py) approximated the fix by shrinking the batch between
 segments; this module does the real thing, the way continuous-batching
-servers (vLLM-style) do, but host-driven and offline-batch shaped:
+servers (vLLM-style) do, but host-driven and offline-batch shaped.
+
+Since the decode-session refactor the mechanism lives in
+`sampler/paged/session.py` (`DecodeSession` owns the carry, the page
+table, admission/step/release, the speculative draft seeds, and the
+chunked-prefill backlog); this module is the QUEUE-POLICY driver: it maps
+queue indices onto resident rows, collects finished rows' outputs in
+queue order, and assembles the paged/spec stats surfaces. The serving
+engine (serving/engine.py) drives the same session with open-loop
+traffic — one scheduler code path for rollout and gateway streams,
+test-pinned.
+
+Scheduling shape (unchanged by the refactor):
 
   * `decode_rows` rows are RESIDENT in a fixed-shape jitted decode loop over
     a page pool sized for exactly those rows
     (`decode_rows * ceil((Tp + max_tokens)/page_size)` pages).
   * The loop runs in chunks of `sync_every` iterations. At each host sync,
     rows that emitted EOS are flushed to the output buffer, their pages
-    handed back to the free list (`pages.release_row`), and the next queued
-    prompt is admitted mid-loop: `pages.alloc_row` claims the freed pages, a
-    single-row prefill writes the prompt KV through the row's new block
-    table into the shared pool, and the row's carry slots are re-installed.
-    Batch shape, pool shape, and compiled code never change.
+    handed back (free list or radix refcount), and the next queued prompt
+    is admitted mid-loop. Batch shape, pool shape, and compiled code never
+    change.
   * Decode iterations are counted (the carry's global counter only advances
     while at least one row is live), which is what the long-tail test and
     bench's `detail.paged` compare against the fixed-batch schedule.
 
-Speculative decode composes: `spec_k > 0` runs the SAME chunk structure over
-the speculative carry, reusing `speculative._draft_fn`/`_verify_fn` with the
-live block table — per-row accept lengths are already per-row bookkeeping,
-so admission just resets one row's slots.
+Feature composition (the session's reason to exist — see
+`sampler.compose_check` for the full matrix):
+
+  * `spec_k > 0` runs draft+verify chunks over the speculative carry.
+  * `prefix_cache` routes admissions through the radix tree; COMPOSES
+    with spec decode — the drafter seeds its lookup window from the
+    cached continuation of the matched prefix, so overlapping corpora
+    accept drafts from the first generated token.
+  * `prefill_chunk > 0` splits long cold admissions into KV-only chunk
+    forwards interleaved with decode chunks (resident rows keep
+    emitting while a long prompt prefills). Chunked-on/off streams are
+    bit-identical; the initial non-radix batch stays batched-unchunked
+    (there are no resident rows to protect yet).
 
 Determinism: row streams are NOT bit-identical to the monolithic loop. The
 per-iteration sampling key is `fold_in(key, it)` over the GLOBAL iteration
@@ -46,192 +65,25 @@ the previous owner never leak through the masked attention.
 
 from __future__ import annotations
 
-import time
-from functools import partial
-
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nanorlhf_tpu.core.model import decode_step, prefill
-from nanorlhf_tpu.sampler.paged.pages import (
-    PageState, alloc_row, blocks_per_row, full_table, release_row,
+from nanorlhf_tpu.sampler.paged.pages import blocks_per_row
+# the jitted primitives and the session live in session.py; the names are
+# re-exported here because envs/rollout.py's episode driver and older
+# callers import them from the scheduler module
+from nanorlhf_tpu.sampler.paged.session import (  # noqa: F401
+    _ADMIT_BASE,
+    _admit_one,
+    _admit_sample,
+    _alloc_jit,
+    _decode_chunk,
+    _install_row,
+    _prefill_state_jit,
+    _release_jit,
+    _spec_chunk,
+    DecodeSession,
 )
-from nanorlhf_tpu.sampler.sampler import (
-    _prefill_state,
-    _sample_token,
-    _token_logprob,
-)
-
-# admitted rows re-key the PRNG far away from the per-iteration fold_in
-# stream (iteration counters are bounded by max_tokens << this)
-_ADMIT_BASE = 10_000_000
-
-# the scheduler drives _prefill_state from the host (sampler.py's callers
-# run it inside their own jits), so it needs its own jit wrapper or the
-# initial batch prefill executes op-by-op
-_prefill_state_jit = partial(
-    jax.jit,
-    static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
-                     "temperature", "top_p", "greedy", "lora_scale", "top_k",
-                     "capture_logprobs", "approx_top_k", "page_size"),
-)(_prefill_state)
-
-_CHUNK_STATIC = (
-    "config", "Tp", "max_tokens", "page_size", "sync_every", "eos_token_id",
-    "pad_token_id", "temperature", "top_p", "greedy", "lora_scale", "top_k",
-    "capture_logprobs", "approx_top_k",
-)
-
-
-def _queued_decode_body(params, config, s, table, *, Tp, max_tokens,
-                        page_size, eos_token_id, pad_token_id, temperature,
-                        top_p, greedy, lora_scale, top_k, capture_logprobs,
-                        approx_top_k):
-    """One decode step over the queued carry — `sampler._decode_body`
-    generalized to PER-ROW generation counts (resident rows sit at
-    different depths) and table-routed cache writes."""
-    (it, out, lp_out, caches, key_mask, done, cur_tok, n_gen, prompt_len,
-     key) = s
-    R = cur_tok.shape[0]
-    rows = jnp.arange(R)
-    slot = Tp + n_gen - 1                      # [R] cache slot of cur_tok
-    key_mask = key_mask.at[rows, slot].set(True)
-    position = prompt_len + n_gen - 1
-    logits, caches = decode_step(
-        params, config, cur_tok, position, slot, key_mask, caches,
-        lora_scale=lora_scale, page_table=table, page_size=page_size,
-    )
-    tok = _sample_token(jax.random.fold_in(key, it), logits, temperature,
-                        top_p, greedy, top_k, approx_top_k)
-    tok = jnp.where(done, pad_token_id, tok)
-    live = ~done
-    wpos = jnp.where(live, n_gen, max_tokens)  # done rows drop their write
-    out = out.at[rows, wpos].set(tok, mode="drop")
-    if capture_logprobs:
-        lp = _token_logprob(logits, tok, temperature)
-        lp_out = lp_out.at[rows, wpos].set(lp, mode="drop")
-    cur_tok = jnp.where(live, tok, cur_tok)
-    n_gen = n_gen + live.astype(jnp.int32)
-    done = done | (tok == eos_token_id) | (n_gen >= max_tokens)
-    return (it + 1, out, lp_out, caches, key_mask, done, cur_tok, n_gen,
-            prompt_len, key)
-
-
-@partial(jax.jit, static_argnames=_CHUNK_STATIC)
-def _decode_chunk(params, config, state, table, **statics):
-    """Up to `sync_every` decode iterations; exits early once every resident
-    row is done (the iteration counter then stops, so it counts true decode
-    dispatches)."""
-    sync_every = statics.pop("sync_every")
-
-    def cond(cs):
-        c, s = cs
-        return (c < sync_every) & ~jnp.all(s[5])
-
-    def body(cs):
-        c, s = cs
-        return c + 1, _queued_decode_body(params, config, s, table, **statics)
-
-    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
-    return state
-
-
-_SPEC_CHUNK_STATIC = _CHUNK_STATIC + ("spec_k", "spec_ngram")
-
-
-@partial(jax.jit, static_argnames=_SPEC_CHUNK_STATIC)
-def _spec_chunk(params, config, state, table, prompt_rep, **statics):
-    """Speculative twin of `_decode_chunk`: draft + verify per iteration
-    over the 15-slot speculative carry, with the live block table routed
-    into the verify forward. `prompt_rep` is the RESIDENT prompts [R, Tp]
-    (it changes at admission, hence a traced argument)."""
-    from nanorlhf_tpu.sampler.speculative import _draft_fn, _verify_fn
-
-    sync_every = statics.pop("sync_every")
-    spec_ngram = statics.pop("spec_ngram")
-    ver_kw = dict(statics)
-    ver_kw.pop("pad_token_id")
-    spec_k = statics["spec_k"]
-    Tp, pad = statics["Tp"], statics["pad_token_id"]
-
-    def cond(cs):
-        c, s = cs
-        return (c < sync_every) & ~jnp.all(s[5])
-
-    def body(cs):
-        c, s = cs
-        drafts = _draft_fn(prompt_rep, s, Tp=Tp, spec_k=spec_k,
-                           spec_ngram=spec_ngram, pad_token_id=pad)
-        return c + 1, _verify_fn(params, config, s, drafts, page_table=table,
-                                 pad_token_id=pad, **ver_kw)
-
-    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
-    return state
-
-
-@partial(jax.jit, static_argnames=("config", "page_size", "T_max",
-                                   "temperature", "top_p", "greedy", "top_k",
-                                   "approx_top_k", "lora_scale"))
-def _admit_one(params, config, pids, pmask, caches, row_table, key, *,
-               page_size, T_max, temperature, top_p, greedy, top_k,
-               approx_top_k, lora_scale):
-    """Single-row admission prefill: write the prompt KV through the row's
-    freshly allocated block table into the SHARED pool, sample the first
-    token. pids/pmask: [1, Tp]; row_table: [nb]. Returns
-    (caches, tok0, lp0, prompt_len) with row-0 scalars."""
-    logits, caches = prefill(
-        params, config, pids, pmask.astype(bool), caches,
-        lora_scale=lora_scale, page_table=row_table[None, :],
-        page_size=page_size, logical_len=T_max,
-    )
-    tok0 = _sample_token(key, logits, temperature, top_p, greedy, top_k,
-                         approx_top_k)
-    lp0 = _token_logprob(logits, tok0, temperature)
-    plen = jnp.sum(pmask.astype(jnp.int32), axis=1)
-    return caches, tok0[0], lp0[0], plen[0]
-
-
-@partial(jax.jit, static_argnames=("Tp", "max_tokens", "eos_token_id",
-                                   "pad_token_id", "spec"))
-def _install_row(state, caches, r, tok0, lp0, pmask_row, plen, *, Tp,
-                 max_tokens, eos_token_id, pad_token_id, spec):
-    """Re-initialize resident row `r` of the carry for a freshly admitted
-    prompt (out/lp rows cleared, key_mask reset to the prompt mask, counters
-    to the post-prefill values). Works for both carry layouts — the first
-    ten slots of the spec carry line up, and `spec` additionally resets the
-    per-row accepted-draft counter."""
-    s = list(state)
-    T_mask = s[4].shape[1]
-    s[3] = caches
-    s[1] = s[1].at[r].set(
-        jnp.full((max_tokens,), pad_token_id, jnp.int32).at[0].set(tok0))
-    s[2] = s[2].at[r].set(jnp.zeros((max_tokens,), jnp.float32).at[0].set(lp0))
-    s[4] = s[4].at[r].set(
-        jnp.zeros((T_mask,), bool).at[:Tp].set(pmask_row.astype(bool)))
-    s[5] = s[5].at[r].set(tok0 == eos_token_id)
-    s[6] = s[6].at[r].set(tok0)
-    s[7] = s[7].at[r].set(jnp.int32(1))
-    s[8] = s[8].at[r].set(plen)
-    if spec:
-        s[14] = s[14].at[r].set(jnp.int32(0))
-    return tuple(s)
-
-
-_release_jit = jax.jit(release_row)
-_alloc_jit = jax.jit(alloc_row)
-
-
-@partial(jax.jit, static_argnames=("temperature", "top_p", "greedy", "top_k",
-                                   "approx_top_k"))
-def _admit_sample(logits, key, *, temperature, top_p, greedy, top_k,
-                  approx_top_k):
-    """First token + logprob from a single row's admission logits [V] —
-    the sampling half of `_admit_one`, split out so the radix path can
-    feed it suffix-prefill logits instead of full-prefill logits."""
-    tok0 = _sample_token(key, logits[None, :], temperature, top_p, greedy,
-                         top_k, approx_top_k)
-    return tok0[0], _token_logprob(logits[None, :], tok0, temperature)[0]
 
 
 def generate_tokens_queued(
@@ -239,7 +91,7 @@ def generate_tokens_queued(
     config,
     prompt_ids: jnp.ndarray,    # [Q, Tp] — ALL queued prompts, left-padded
     prompt_mask: jnp.ndarray,   # [Q, Tp]
-    key: jax.Array,
+    key,
     *,
     max_tokens: int,
     eos_token_id: int,
@@ -256,6 +108,7 @@ def generate_tokens_queued(
     capture_logprobs: bool = False,
     approx_top_k: bool = True,
     sync_every: int = 8,
+    prefill_chunk: int = 0,
     spec_stats_out: list | None = None,
     paged_stats_out: list | None = None,
     latency=None,
@@ -285,260 +138,109 @@ def generate_tokens_queued(
     prompt repeats. Greedy streams stay bit-identical to the uncached path
     (test-pinned); sampled streams are equal in distribution only (cold
     initial rows draw tok0 from the per-queue-index admission fold instead
-    of the batched fold_in(key, 0)). Incompatible with spec_k > 0."""
+    of the batched fold_in(key, 0)). COMPOSES with `spec_k > 0`: finished
+    rows' generated text extends the radix tree, seeding the drafter of
+    later overlapping admissions.
+
+    `prefill_chunk > 0` splits every per-row admission whose real suffix
+    exceeds the chunk width into KV-only forwards, one per sync chunk —
+    greedy/sampled streams are bit-identical to `prefill_chunk=0` (the
+    final chunk samples from the same admission fold)."""
     Q, Tp = prompt_ids.shape
     R = min(int(decode_rows), Q)
     P = int(page_size)
     T_max = Tp + max_tokens
     nb = blocks_per_row(T_max, P)
-    N = R * nb
     spec = spec_k > 0
 
     radix = prefix_cache if (prefix_cache is not None
                              and getattr(prefix_cache, "enabled", False)) \
         else None
-    if radix is not None and spec:
-        raise ValueError(
-            "prefix_cache is incompatible with spec_k > 0: the radix "
-            "admission path derives per-row cache fill from the matched "
-            "prefix, which the speculative carry's per-row accept "
-            "bookkeeping does not model — run one lever at a time.")
 
-    hub = latency if (latency is not None and latency.enabled) else None
-    sample_kw = dict(temperature=temperature, top_p=top_p, greedy=greedy,
-                     top_k=top_k, approx_top_k=approx_top_k)
+    sess = DecodeSession(
+        params, config, rows=R, prompt_len=Tp, max_tokens=max_tokens,
+        page_size=P, eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+        key=key, temperature=temperature, top_p=top_p, greedy=greedy,
+        top_k=top_k, approx_top_k=approx_top_k,
+        capture_logprobs=capture_logprobs, lora_scale=lora_scale,
+        spec_k=spec_k, spec_ngram=spec_ngram, prefix_cache=radix,
+        prefill_chunk=int(prefill_chunk), sync_every=int(sync_every),
+        latency=latency)
+    N = sess.num_pages
+    stats0 = dict(radix.stats) if radix is not None else None
 
     prompt_np = np.asarray(prompt_ids)
     pmask_np = np.asarray(prompt_mask)
-    dispatch_tokens = 0            # Σ Tq over prefill/suffix dispatches —
-    hit_tokens = 0                 # the A/B's "prefill FLOPs" proxy
-    shared_peak = 0                # max pages/shared over sync points
-
-    if radix is not None:
-        from nanorlhf_tpu.core.model import init_paged_kv_cache
-        from nanorlhf_tpu.serving.radix import (
-            bucket_len, copy_page, prompt_key, suffix_logits,
-        )
-
-        N = R * nb + radix.extra_pages(R, nb)
-        radix.reset(num_pages=N, page_size=P)
-        stats0 = dict(radix.stats)
-        caches0 = init_paged_kv_cache(
-            config, N, P, params["embed_tokens"].dtype)
-        # empty carry: every row starts done; _radix_admit installs the
-        # initial batch through the same path mid-loop admissions use
-        state = (jnp.int32(1),
-                 jnp.full((R, max_tokens), pad_token_id, jnp.int32),
-                 jnp.zeros((R, max_tokens), jnp.float32),
-                 caches0,
-                 jnp.zeros((R, T_max), bool),
-                 jnp.ones((R,), bool),
-                 jnp.zeros((R,), jnp.int32),
-                 jnp.ones((R,), jnp.int32),
-                 jnp.zeros((R,), jnp.int32),
-                 key)
-        table_np = np.full((R, nb), N, np.int32)
-        pstate = None
-
-        def _radix_admit(q, r, state):
-            """Admit queue index `q` into resident row `r` through the
-            radix cache: refcount-share the matched full pages, COW-split
-            a mid-page straddler, prefill only the suffix."""
-            nonlocal dispatch_tokens, hit_tokens
-            t_admit0 = time.perf_counter()
-            toks, msk = prompt_np[q], pmask_np[q].astype(bool)
-            kelems = prompt_key(toks, msk)
-            pad_count = int(Tp - msk.sum())
-            plan = radix.plan(kelems, pad_count=pad_count, n_blocks=nb,
-                              prompt_len=Tp)
-            table_np[r] = plan.row_pages
-            admit_key = jax.random.fold_in(key, _ADMIT_BASE + q)
-            caches = state[3]
-            if plan.cow_src is not None:
-                caches = copy_page(caches, plan.cow_src, plan.cow_dst)
-            if plan.m == 0:
-                # cold: the row's pages are all fresh, so the full
-                # single-row prefill is IDENTICAL to the uncached path
-                caches, t0, l0, pl = _admit_one(
-                    params, config, prompt_ids[q:q + 1],
-                    prompt_mask[q:q + 1], caches,
-                    jnp.asarray(plan.row_pages), admit_key,
-                    page_size=P, T_max=T_max, lora_scale=lora_scale,
-                    **sample_kw)
-                dispatch_tokens += Tp
-            else:
-                m = plan.m
-                s_real = Tp - m
-                Sb = bucket_len(s_real, T_max - m)
-                suffix = np.zeros((1, Sb), np.int32)
-                suffix[0, :s_real] = toks[m:]
-                pos = (m - pad_count) + np.arange(Sb, dtype=np.int32)[None]
-                km = np.zeros((1, T_max), bool)
-                km[0, pad_count:m] = True
-                logits, caches = suffix_logits(
-                    params, config, jnp.asarray(suffix), jnp.asarray(pos),
-                    jnp.asarray([m], jnp.int32), jnp.int32(s_real - 1),
-                    jnp.asarray(km), caches, jnp.asarray(plan.row_pages),
-                    page_size=P, lora_scale=lora_scale)
-                t0, l0 = _admit_sample(logits, admit_key, **sample_kw)
-                pl = jnp.int32(int(msk.sum()))
-                dispatch_tokens += Sb
-                hit_tokens += plan.hit_tokens
-            radix.insert(kelems, plan.row_pages, Tp)
-            if hub is not None:
-                jax.block_until_ready(t0)
-                hub.record("latency/ttft_s",
-                           time.perf_counter() - t_admit0)
-            return _install_row(
-                state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
-                max_tokens=max_tokens, eos_token_id=eos_token_id,
-                pad_token_id=pad_token_id, spec=False)
-
-        for r in range(R):
-            state = _radix_admit(r, r, state)
-    else:
-        # ---- initial admission: batch-prefill the first R prompts. The
-        # fresh pool is fully claimed by the identity table (exactly what
-        # _prefill_state builds), so the allocator starts with an EMPTY
-        # free list; release/alloc churn begins at the first EOS.
-        t_prefill0 = time.perf_counter()
-        base = _prefill_state_jit(
-            params, config, prompt_ids[:R], prompt_mask[:R], key,
-            max_tokens=max_tokens, eos_token_id=eos_token_id,
-            pad_token_id=pad_token_id, temperature=temperature, top_p=top_p,
-            greedy=greedy, lora_scale=lora_scale, top_k=top_k,
-            capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
-            page_size=P,
-        )
-        (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
-        dispatch_tokens += R * Tp
-        if hub is not None:
-            # every initial-batch row's first token exists once this
-            # prefill lands: one TTFT observation per admitted request
-            jax.block_until_ready(tok0)
-            ttft0 = time.perf_counter() - t_prefill0
-            for _ in range(R):
-                hub.record("latency/ttft_s", ttft0)
-        pstate = PageState(free=jnp.arange(N, dtype=jnp.int32),
-                           top=jnp.asarray(0, jnp.int32),
-                           table=full_table(R, nb))
-        n_gen0 = jnp.ones((R,), jnp.int32)
-        if spec:
-            from nanorlhf_tpu.sampler.speculative import _spec_state
-            state = _spec_state(base)
-        else:
-            state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0,
-                     tok0, n_gen0, plen0, key)
-
-    statics = dict(
-        Tp=Tp, max_tokens=max_tokens, page_size=P, sync_every=int(sync_every),
-        eos_token_id=eos_token_id, pad_token_id=pad_token_id,
-        temperature=temperature, top_p=top_p, greedy=greedy,
-        lora_scale=lora_scale, top_k=top_k,
-        capture_logprobs=capture_logprobs, approx_top_k=approx_top_k,
-    )
-    if spec:
-        statics.update(spec_k=spec_k, spec_ngram=spec_ngram)
 
     # host bookkeeping
     out_all = np.full((Q, max_tokens), pad_token_id, np.int32)
     lp_all = np.zeros((Q, max_tokens), np.float32)
     acc_all = np.zeros((Q,), np.int64)            # spec: accepted drafts/row
-    owner = list(range(R))                        # resident row → queue index
-    prompt_res_np = np.array(prompt_np[:R])       # resident prompts (spec)
-    prompt_rep = jnp.asarray(prompt_res_np)
-    next_q = R
+    owner = [-1] * R                              # resident row → queue index
+    next_q = 0
     recycled = 0
     admissions: list[dict] = []
     util_samples: list[float] = []
+    shared_peak = 0
 
-    it_prev = int(state[0]) - 1
+    if radix is not None:
+        # initial batch admits row-by-row through the radix path (the
+        # same path mid-loop admissions use)
+        for r in range(R):
+            sess.admit(r, prompt_np[next_q], pmask_np[next_q], next_q)
+            owner[r] = next_q
+            next_q += 1
+    else:
+        sess.bootstrap(prompt_ids, prompt_mask)
+        owner = list(range(R))
+        next_q = R
+
     while True:
-        t_chunk0 = time.perf_counter()
-        table_dev = (jnp.asarray(table_np) if radix is not None
-                     else pstate.table)
+        done_h, installed = sess.step()
+        it_now = sess.iterations()
+        if installed is not None:
+            admissions.append({"row": installed[0],
+                               "queue_index": owner[installed[0]],
+                               "iteration": it_now, "chunked": True})
         if spec:
-            state = _spec_chunk(params, config, state, table_dev,
-                                prompt_rep, **statics)
-        else:
-            state = _decode_chunk(params, config, state, table_dev,
-                                  **statics)
-        done_h = np.asarray(state[5])
-        it_now = int(state[0]) - 1
-        if hub is not None:
-            # done_h forced the device sync, so the chunk's wall time is
-            # fully realised here; one mean inter-token gap per sync chunk
-            hub.record("latency/intertoken_s",
-                       (time.perf_counter() - t_chunk0)
-                       / max(1, it_now - it_prev))
-        it_prev = it_now
-        if spec:
-            row_acc_h = np.asarray(state[14])
+            row_acc_h = np.asarray(sess.state[14])
+            n_gen_h = np.asarray(sess.state[7])
 
-        finished = [r for r in range(R) if done_h[r] and owner[r] >= 0]
+        pending = sess.pending_rows()
+        finished = [r for r in range(R)
+                    if done_h[r] and owner[r] >= 0 and r not in pending]
         for r in finished:
             q = owner[r]
-            out_all[q] = np.asarray(state[1][r])
+            out_all[q] = np.asarray(sess.state[1][r])
             if capture_logprobs:
-                lp_all[q] = np.asarray(state[2][r])
+                lp_all[q] = np.asarray(sess.state[2][r])
+            gen = None
             if spec:
                 acc_all[q] = int(row_acc_h[r])
+                gen = out_all[q][:int(n_gen_h[r])]
             owner[r] = -1
-            if radix is not None:
-                # drop the REQUEST's refs; pages the tree still holds
-                # survive as cached prefix KV for later admissions
-                recycled += radix.release(table_np[r])
-                table_np[r] = N
-            else:
-                pstate, m = _release_jit(pstate, r)
-                recycled += int(m)
+            # radix: drop the REQUEST's refs; pages the tree still holds
+            # survive as cached prefix KV (and, with spec, the generated
+            # text extends the tree for the drafter seed)
+            recycled += sess.release(r, gen_tokens=gen)
         for r in finished:
             if next_q >= Q:
                 continue
             q = next_q
             next_q += 1
-            if radix is not None:
-                state = _radix_admit(q, r, state)
-            else:
-                pstate, ok = _alloc_jit(pstate, r, nb)
-                assert bool(ok), "allocator underflow: full-budget rows recycle uniformly"
-                t_admit0 = time.perf_counter()
-                caches, t0, l0, pl = _admit_one(
-                    params, config, prompt_ids[q:q + 1], prompt_mask[q:q + 1],
-                    state[3], pstate.table[r],
-                    jax.random.fold_in(key, _ADMIT_BASE + q),
-                    page_size=P, T_max=T_max, temperature=temperature,
-                    top_p=top_p, greedy=greedy, top_k=top_k,
-                    approx_top_k=approx_top_k, lora_scale=lora_scale,
-                )
-                dispatch_tokens += Tp
-                if hub is not None:
-                    # t0 is the admission prefill's sampled first token:
-                    # blocking on it gives this request's true TTFT
-                    jax.block_until_ready(t0)
-                    hub.record("latency/ttft_s",
-                               time.perf_counter() - t_admit0)
-                state = _install_row(
-                    state, caches, r, t0, l0, prompt_mask[q], pl, Tp=Tp,
-                    max_tokens=max_tokens, eos_token_id=eos_token_id,
-                    pad_token_id=pad_token_id, spec=spec,
-                )
+            sess.admit(r, prompt_np[q], pmask_np[q], q)
             owner[r] = q
-            if spec:
-                prompt_res_np[r] = prompt_np[q]
-                prompt_rep = jnp.asarray(prompt_res_np)
-            admissions.append({"row": r, "queue_index": q,
-                               "iteration": it_now})
+            if not sess.is_pending(r):
+                admissions.append({"row": r, "queue_index": q,
+                                   "iteration": it_now})
         # pool occupancy AFTER this sync's churn: allocated / total pages
-        if radix is not None:
-            util_samples.append(1.0 - radix.pool.free_count / N)
-            shared_peak = max(shared_peak, radix.pool.shared_count())
-        else:
-            util_samples.append(1.0 - float(np.asarray(pstate.top)) / N)
-        if next_q >= Q and all(o < 0 for o in owner):
+        util_samples.append(sess.utilization())
+        shared_peak = max(shared_peak, sess.shared_pages())
+        if next_q >= Q and all(o < 0 for o in owner) \
+                and not sess.has_pending():
             break
 
-    n_iter = int(state[0]) - 1
+    n_iter = sess.iterations()
     if paged_stats_out is not None:
         entry = {
             "page_utilization": float(np.mean(util_samples)),
@@ -549,13 +251,19 @@ def generate_tokens_queued(
             "num_pages": N,
             "page_size": P,
             "admissions": admissions,
-            "prefill_token_dispatch": dispatch_tokens,
+            "prefill_token_dispatch": sess.dispatch_tokens,
+            "dispatch_events": sess.dispatch_events(),
+            "chunked_admissions": sess.chunked_admissions,
+            "prefill_backlog_peak": sess.backlog_peak,
+            # end-of-call session snapshot for /statusz "session" (row
+            # feature flags, pending-prefill backlog, dispatch counters)
+            "session": sess.status(),
         }
         if radix is not None:
             lookup_tok = radix.stats["lookup_tokens"] - stats0["lookup_tokens"]
             entry.update({
-                "prefix_hit_tokens": hit_tokens,
-                "prefix_hit_frac": (hit_tokens / lookup_tok
+                "prefix_hit_tokens": sess.hit_tokens,
+                "prefix_hit_frac": (sess.hit_tokens / lookup_tok
                                     if lookup_tok else 0.0),
                 "cow_splits": radix.stats["cow_splits"] - stats0["cow_splits"],
                 "evicted_pages": (radix.stats["evicted_pages"]
@@ -564,6 +272,7 @@ def generate_tokens_queued(
             })
         paged_stats_out.append(entry)
     if spec and spec_stats_out is not None:
+        state = sess.state
         spec_stats_out.append({
             "verify_steps": n_iter,
             "drafted": state[10], "accepted": state[11],

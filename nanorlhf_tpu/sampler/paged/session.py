@@ -1,0 +1,954 @@
+"""The decode session: one composable continuous-batching loop.
+
+Before this module the decode stack was three pairwise-exclusive forks
+over the same machinery: the rollout scheduler's queued loop
+(sampler/paged/scheduler.py), the serving engine's private fixed-shape
+chunk loop (serving/engine.py), and the speculative carry — with the
+radix prefix cache legal in exactly one of them at a time. `DecodeSession`
+collapses the forks: every resident row carries ONE uniform state record —
+page-table row, sampling params, output/logprob/mask slots, speculative
+draft state, and (when admitted through the radix cache) its prefix-cache
+plan — and admission, step, verify, and release are methods on the
+session instead of per-mode code paths. The drivers that remain are thin
+policy loops: the rollout scheduler owns queue order and output
+collection, the serving engine owns threads/SLO/shed, and both submit
+rows into the same jitted chunk functions defined here.
+
+Compositions this buys (all test-pinned, bench-gated):
+
+  * **spec decode under the radix prefix cache** — the n-gram drafter's
+    lookup window is seeded from the radix tree's cached continuation of
+    the matched prefix (`RadixCache.matched_continuation`), so
+    prefix-heavy corpora draft usefully from the first generated token
+    instead of waiting for the row's own buffer to self-repeat. Greedy
+    output is bit-identical to each feature alone (greedy acceptance is
+    draft-independent), with strictly fewer model dispatches on an
+    overlapping corpus.
+  * **chunked prefill** — a long cold prompt's admission is split into
+    `prefill_chunk`-token KV-only forwards (`core/model.decode_verify`
+    with `want_logits=False`) interleaved one-per-sync-chunk with decode
+    steps, so resident rows' inter-token latency no longer absorbs the
+    whole prefill wall. The final chunk runs through `suffix_logits` and
+    samples the first token with the SAME admission PRNG fold as the
+    unchunked path, so chunked-on/off GREEDY output is bit-identical
+    (the suffix-equals-prefill equivalence, chained per chunk); sampled
+    output is equal in distribution only, because a chunk-delayed row
+    decodes at later global `fold_in(key, it)` iterations.
+  * **serving as a session client** — the engine's per-request sampling
+    params ([R] temperature/top_p/greedy/budget arrays) become traced
+    arguments of the shared chunk body instead of a private carry layout;
+    one compiled decode program serves rollout and gateway traffic.
+
+Carry layout (identical to the pre-session scheduler, which is what keeps
+every greedy stream bit-identical through the refactor):
+
+  base  (10): it · out · lp_out · caches · key_mask · done · cur_tok ·
+              n_gen · prompt_len · key
+  spec  (15): base + n_drafted · n_accepted · n_emitted · n_rowsteps ·
+              row_acc   (sampler/speculative.py)
+
+Dispatch accounting: `launches` counts model-forward dispatches
+(admission prefills, per-chunk prefill forwards, decode iterations,
+verify steps — each one full weight stream); `dispatch_tokens` counts
+prefill/suffix tokens only. Spec decode trades MORE tokens per verify
+launch for FEWER launches, so the combined spec+radix A/B gates on
+launches (`dispatch_events`) and on prefill tokens vs the spec-alone
+baseline — docs/DECODE_ANALYSIS.md walks the arithmetic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nanorlhf_tpu.core.model import decode_step, decode_verify, prefill
+from nanorlhf_tpu.ops.masking import guard_temperature
+from nanorlhf_tpu.sampler.paged.pages import (
+    PageState, alloc_row, blocks_per_row, full_table, release_row,
+)
+from nanorlhf_tpu.sampler.sampler import (
+    _nucleus_candidates,
+    _prefill_state,
+    _sample_token,
+    _token_logprob,
+)
+
+# admitted rows re-key the PRNG far away from the per-iteration fold_in
+# stream (iteration counters are bounded by max_tokens << this)
+_ADMIT_BASE = 10_000_000
+
+# the session drives _prefill_state from the host (sampler.py's callers
+# run it inside their own jits), so it needs its own jit wrapper or the
+# initial batch prefill executes op-by-op
+_prefill_state_jit = partial(
+    jax.jit,
+    static_argnames=("config", "max_tokens", "eos_token_id", "pad_token_id",
+                     "temperature", "top_p", "greedy", "lora_scale", "top_k",
+                     "capture_logprobs", "approx_top_k", "page_size"),
+)(_prefill_state)
+
+_CHUNK_STATIC = (
+    "config", "Tp", "max_tokens", "page_size", "sync_every", "eos_token_id",
+    "pad_token_id", "temperature", "top_p", "greedy", "lora_scale", "top_k",
+    "capture_logprobs", "approx_top_k",
+)
+
+
+def _serving_sample(key, logits, temperature, top_p, greedy, *, top_k,
+                    approx_top_k):
+    """Per-ROW sampling: `sampler._sample_token` with `temperature` /
+    `top_p` / `greedy` as traced `[R]` arrays so one compiled decode
+    step serves heterogeneous requests. Both branches are computed and
+    selected with `jnp.where(greedy, ...)`; the nucleus keep rule
+    broadcasts `top_p[:, None]` against the `[R, K]` candidate set.
+    Unlike the rollout sampler there is no exact full-vocab escape for
+    `top_p >= 1` — serving always samples in top-k candidate space
+    (`top_p = 1` keeps every candidate), which is the usual serving
+    trade and keeps the row-mixed program shape fixed."""
+    scaled = (logits.astype(jnp.float32)
+              / guard_temperature(temperature)[:, None])
+    top_logits, top_idx, keep = _nucleus_candidates(
+        scaled, top_p[:, None], top_k, approx_top_k)
+    kept = jnp.where(keep, top_logits, -jnp.inf)
+    choice = jax.random.categorical(key, kept, axis=-1)
+    sampled = jnp.take_along_axis(
+        top_idx, choice[..., None], axis=-1)[..., 0]
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("top_k", "approx_top_k"))
+def _first_token(logits, key, temperature, top_p, greedy, *, top_k,
+                 approx_top_k):
+    """Sample one admission's first token from its suffix logits [V]."""
+    return _serving_sample(key, logits[None, :], temperature[None],
+                           top_p[None], greedy[None], top_k=top_k,
+                           approx_top_k=approx_top_k)[0]
+
+
+def _session_decode_body(params, config, s, table, row_params, *, Tp,
+                         max_tokens, page_size, eos_token_id, pad_token_id,
+                         temperature, top_p, greedy, lora_scale, top_k,
+                         capture_logprobs, approx_top_k):
+    """One decode step over the session carry — `sampler._decode_body`
+    generalized to PER-ROW generation counts (resident rows sit at
+    different depths) and table-routed cache writes. `row_params` is None
+    for the rollout mode (static sampling params, row budget =
+    `max_tokens`) or the serving mode's traced `[R]`
+    (temperature, top_p, greedy, budget) tuple — a trace-time branch, so
+    each mode compiles to exactly the program its pre-session driver ran."""
+    (it, out, lp_out, caches, key_mask, done, cur_tok, n_gen, prompt_len,
+     key) = s
+    R = cur_tok.shape[0]
+    rows = jnp.arange(R)
+    slot = Tp + n_gen - 1                      # [R] cache slot of cur_tok
+    key_mask = key_mask.at[rows, slot].set(True)
+    position = prompt_len + n_gen - 1
+    logits, caches = decode_step(
+        params, config, cur_tok, position, slot, key_mask, caches,
+        lora_scale=lora_scale, page_table=table, page_size=page_size,
+    )
+    if row_params is None:
+        tok = _sample_token(jax.random.fold_in(key, it), logits, temperature,
+                            top_p, greedy, top_k, approx_top_k)
+        limit = max_tokens
+    else:
+        r_temp, r_topp, r_greedy, r_budget = row_params
+        tok = _serving_sample(jax.random.fold_in(key, it), logits, r_temp,
+                              r_topp, r_greedy, top_k=top_k,
+                              approx_top_k=approx_top_k)
+        limit = r_budget
+    tok = jnp.where(done, pad_token_id, tok)
+    live = ~done
+    wpos = jnp.where(live, n_gen, max_tokens)  # done rows drop their write
+    out = out.at[rows, wpos].set(tok, mode="drop")
+    if capture_logprobs:
+        lp = _token_logprob(logits, tok, temperature)
+        lp_out = lp_out.at[rows, wpos].set(lp, mode="drop")
+    cur_tok = jnp.where(live, tok, cur_tok)
+    n_gen = n_gen + live.astype(jnp.int32)
+    done = done | (tok == eos_token_id) | (n_gen >= limit)
+    return (it + 1, out, lp_out, caches, key_mask, done, cur_tok, n_gen,
+            prompt_len, key)
+
+
+def _chunk_loop(params, config, state, table, row_params, statics):
+    """Up to `sync_every` decode iterations; exits early once every
+    resident row is done (the iteration counter then stops, so it counts
+    true decode dispatches)."""
+    statics = dict(statics)
+    sync_every = statics.pop("sync_every")
+
+    def cond(cs):
+        c, s = cs
+        return (c < sync_every) & ~jnp.all(s[5])
+
+    def body(cs):
+        c, s = cs
+        return c + 1, _session_decode_body(params, config, s, table,
+                                           row_params, **statics)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+@partial(jax.jit, static_argnames=_CHUNK_STATIC)
+def _decode_chunk(params, config, state, table, **statics):
+    """Rollout-mode chunk: static sampling params (the pre-session
+    scheduler's `_decode_chunk`, bit-identical program)."""
+    return _chunk_loop(params, config, state, table, None, statics)
+
+
+@partial(jax.jit, static_argnames=_CHUNK_STATIC)
+def _serving_chunk(params, config, state, table, r_temp, r_topp, r_greedy,
+                   r_budget, **statics):
+    """Serving-mode chunk: per-request sampling params and token budgets
+    ride as traced [R] arrays (the pre-session engine's `_engine_chunk`,
+    bit-identical program — the params moved from carry slots to
+    arguments, the values are the same)."""
+    return _chunk_loop(params, config, state, table,
+                       (r_temp, r_topp, r_greedy, r_budget), statics)
+
+
+_SPEC_CHUNK_STATIC = _CHUNK_STATIC + ("spec_k", "spec_ngram")
+
+
+def _spec_loop(params, config, state, table, prompt_rep, seed_rep, seed_len,
+               statics):
+    """Speculative twin of `_chunk_loop`: draft + verify per iteration
+    over the 15-slot speculative carry, with the live block table routed
+    into the verify forward. `prompt_rep` is the RESIDENT prompts [R, Tp]
+    (it changes at admission, hence a traced argument); `seed_rep` /
+    `seed_len`, when present, prepend the radix-matched cached
+    continuation to each row's n-gram lookup window
+    (`speculative._draft_fn`)."""
+    from nanorlhf_tpu.sampler.speculative import _draft_fn, _verify_fn
+
+    statics = dict(statics)
+    sync_every = statics.pop("sync_every")
+    spec_ngram = statics.pop("spec_ngram")
+    ver_kw = dict(statics)
+    ver_kw.pop("pad_token_id")
+    spec_k = statics["spec_k"]
+    Tp, pad = statics["Tp"], statics["pad_token_id"]
+
+    def cond(cs):
+        c, s = cs
+        return (c < sync_every) & ~jnp.all(s[5])
+
+    def body(cs):
+        c, s = cs
+        drafts = _draft_fn(prompt_rep, s, Tp=Tp, spec_k=spec_k,
+                           spec_ngram=spec_ngram, pad_token_id=pad,
+                           seed_rep=seed_rep, seed_len=seed_len)
+        return c + 1, _verify_fn(params, config, s, drafts, page_table=table,
+                                 pad_token_id=pad, **ver_kw)
+
+    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
+    return state
+
+
+@partial(jax.jit, static_argnames=_SPEC_CHUNK_STATIC)
+def _spec_chunk(params, config, state, table, prompt_rep, **statics):
+    """Spec chunk, own-buffer drafting only (spec without the radix
+    cache — the pre-session scheduler's `_spec_chunk`)."""
+    return _spec_loop(params, config, state, table, prompt_rep, None, None,
+                      statics)
+
+
+@partial(jax.jit, static_argnames=_SPEC_CHUNK_STATIC)
+def _spec_chunk_seeded(params, config, state, table, prompt_rep, seed_rep,
+                       seed_len, **statics):
+    """Spec chunk with the radix-seeded lookup window (spec × prefix
+    cache). Greedy acceptance is draft-independent, so seeding changes
+    dispatch counts, never greedy output."""
+    return _spec_loop(params, config, state, table, prompt_rep, seed_rep,
+                      seed_len, statics)
+
+
+@partial(jax.jit, static_argnames=("config", "page_size", "T_max",
+                                   "temperature", "top_p", "greedy", "top_k",
+                                   "approx_top_k", "lora_scale"))
+def _admit_one(params, config, pids, pmask, caches, row_table, key, *,
+               page_size, T_max, temperature, top_p, greedy, top_k,
+               approx_top_k, lora_scale):
+    """Single-row admission prefill: write the prompt KV through the row's
+    freshly allocated block table into the SHARED pool, sample the first
+    token. pids/pmask: [1, Tp]; row_table: [nb]. Returns
+    (caches, tok0, lp0, prompt_len) with row-0 scalars."""
+    logits, caches = prefill(
+        params, config, pids, pmask.astype(bool), caches,
+        lora_scale=lora_scale, page_table=row_table[None, :],
+        page_size=page_size, logical_len=T_max,
+    )
+    tok0 = _sample_token(key, logits, temperature, top_p, greedy, top_k,
+                         approx_top_k)
+    lp0 = _token_logprob(logits, tok0, temperature)
+    plen = jnp.sum(pmask.astype(jnp.int32), axis=1)
+    return caches, tok0[0], lp0[0], plen[0]
+
+
+@partial(jax.jit, static_argnames=("Tp", "max_tokens", "eos_token_id",
+                                   "pad_token_id", "spec", "per_row"))
+def _install_row(state, caches, r, tok0, lp0, pmask_row, plen, budget=None,
+                 *, Tp, max_tokens, eos_token_id, pad_token_id, spec,
+                 per_row=False):
+    """Re-initialize resident row `r` of the carry for a freshly admitted
+    prompt (out/lp rows cleared, key_mask reset to the prompt mask, counters
+    to the post-prefill values). Works for both carry layouts — the first
+    ten slots of the spec carry line up, and `spec` additionally resets the
+    per-row accepted-draft counter. `per_row` (serving) folds the traced
+    token `budget` into the initial done flag (a budget-1 request is done
+    at its first token)."""
+    s = list(state)
+    T_mask = s[4].shape[1]
+    s[3] = caches
+    s[1] = s[1].at[r].set(
+        jnp.full((max_tokens,), pad_token_id, jnp.int32).at[0].set(tok0))
+    s[2] = s[2].at[r].set(jnp.zeros((max_tokens,), jnp.float32).at[0].set(lp0))
+    s[4] = s[4].at[r].set(
+        jnp.zeros((T_mask,), bool).at[:Tp].set(pmask_row.astype(bool)))
+    if per_row:
+        s[5] = s[5].at[r].set((tok0 == eos_token_id) | (budget <= 1))
+    else:
+        s[5] = s[5].at[r].set(tok0 == eos_token_id)
+    s[6] = s[6].at[r].set(tok0)
+    s[7] = s[7].at[r].set(jnp.int32(1))
+    s[8] = s[8].at[r].set(plen)
+    if spec:
+        s[14] = s[14].at[r].set(jnp.int32(0))
+    return tuple(s)
+
+
+_release_jit = jax.jit(release_row)
+_alloc_jit = jax.jit(alloc_row)
+
+
+@partial(jax.jit, static_argnames=("temperature", "top_p", "greedy", "top_k",
+                                   "approx_top_k"))
+def _admit_sample(logits, key, *, temperature, top_p, greedy, top_k,
+                  approx_top_k):
+    """First token + logprob from a single row's admission logits [V] —
+    the sampling half of `_admit_one`, split out so the radix path can
+    feed it suffix-prefill logits instead of full-prefill logits."""
+    tok0 = _sample_token(key, logits[None, :], temperature, top_p, greedy,
+                         top_k, approx_top_k)
+    return tok0[0], _token_logprob(logits[None, :], tok0, temperature)[0]
+
+
+@partial(jax.jit, static_argnames=("config", "page_size", "lora_scale"))
+def _prefill_chunk_fwd(params, config, chunk_ids, positions, fill, key_mask,
+                       caches, row_table, *, page_size, lora_scale):
+    """One KV-only prefill chunk: a `decode_verify` forward over a
+    fixed-width slice of a long cold prompt, writing its KV through the
+    row's block table and skipping the lm_head matmul entirely
+    (`want_logits=False`) — only the FINAL chunk needs logits, and it
+    runs through `suffix_logits` instead."""
+    _, caches = decode_verify(
+        params, config, chunk_ids, positions, fill, key_mask, caches,
+        lora_scale=lora_scale, page_table=row_table[None, :],
+        page_size=page_size, want_logits=False,
+    )
+    return caches
+
+
+@dataclass
+class _PendingPrefill:
+    """A chunked admission in flight: the row's pages are claimed and its
+    carry row is parked done=True; `next_slot` advances one chunk per
+    session step until the final chunk installs the row."""
+    row: int
+    toks: np.ndarray              # [Tp] left-padded
+    mask: np.ndarray              # [Tp] bool
+    pad_count: int
+    next_slot: int                # next absolute cache slot to prefill
+    admit_key: jax.Array
+    t_start: float
+    kelems: Optional[tuple] = None        # radix key (radix mode)
+    plan_hit: int = 0
+    seed: Optional[np.ndarray] = None     # drafter seed (spec × radix)
+    budget: Optional[int] = None          # per-row mode request params
+    temperature: float = 1.0
+    top_p: float = 1.0
+    greedy: bool = False
+    row_table: Optional[np.ndarray] = None  # non-radix: device row snapshot
+    meta: dict = field(default_factory=dict)
+
+
+class DecodeSession:
+    """One resident decode batch with uniform per-row state.
+
+    Owns the carry, the page table (radix-refcounted or device
+    free-stack), the speculative draft seeds, the chunked-prefill
+    backlog, and the latency-hub recording; exposes
+    `admit` / `bootstrap` / `step` / `release` / `cancel_row` to the two
+    drivers (rollout scheduler, serving engine). Modes:
+
+      * `per_row=False` (rollout): static sampling params, every row
+        shares `max_tokens`; spec decode composes (`spec_k > 0`), with
+        the drafter seeded from the radix tree when `prefix_cache` is
+        also attached.
+      * `per_row=True` (serving): traced per-row temperature / top_p /
+        greedy / budget; `capture_logprobs` is illegal (the logprob
+        write needs a static temperature) — `sampler.compose_check`
+        documents the matrix.
+
+    The session NEVER resets an attached `prefix_cache` implicitly at
+    step time — it resets it exactly once at construction (the rollout
+    driver builds a session per generate call, giving the per-call reset
+    the staleness note in serving/radix.py requires; the engine builds
+    one session for its lifetime, keeping its tree warm)."""
+
+    def __init__(self, params, config, *, rows, prompt_len, max_tokens,
+                 page_size, eos_token_id, pad_token_id, key,
+                 temperature=1.0, top_p=0.95, greedy=False, top_k=64,
+                 approx_top_k=True, capture_logprobs=False, lora_scale=1.0,
+                 per_row=False, spec_k=0, spec_ngram=3, prefix_cache=None,
+                 prefill_chunk=0, sync_every=8, latency=None,
+                 admit_key=None):
+        if per_row and capture_logprobs:
+            raise ValueError(
+                "capture_logprobs is incompatible with per-row sampling "
+                "params: the logprob write shares the chunk body's static "
+                "temperature — see sampler.compose_check")
+        if per_row and spec_k > 0 and not greedy:
+            raise ValueError(
+                "per-row spec decode requires the session's static "
+                "greedy=True: the verify/accept rule compiles against "
+                "static sampling params, so a spec serving engine admits "
+                "greedy requests only — see sampler.compose_check")
+        self.params = params
+        self.config = config
+        self.rows = int(rows)
+        self.Tp = int(prompt_len)
+        self.max_tokens = int(max_tokens)
+        self.page_size = int(page_size)
+        self.eos_token_id = int(eos_token_id)
+        self.pad_token_id = int(pad_token_id)
+        self.per_row = bool(per_row)
+        self.spec = int(spec_k) > 0
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        self.prefill_chunk = int(prefill_chunk)
+        self.lora_scale = lora_scale
+        self.capture_logprobs = bool(capture_logprobs)
+        self._key = key
+        self._admit_key = key if admit_key is None else admit_key
+        self._hub = latency if (latency is not None
+                                and getattr(latency, "enabled", False)) \
+            else None
+
+        self.T_max = self.Tp + self.max_tokens
+        self.nb = blocks_per_row(self.T_max, self.page_size)
+
+        self._radix = prefix_cache if (
+            prefix_cache is not None
+            and getattr(prefix_cache, "enabled", False)) else None
+        if self._radix is not None:
+            self.num_pages = (self.rows * self.nb
+                              + self._radix.extra_pages(self.rows, self.nb))
+            self._radix.reset(num_pages=self.num_pages,
+                              page_size=self.page_size)
+            self.table_np = np.full((self.rows, self.nb), self.num_pages,
+                                    np.int32)
+            self._pstate = None
+        else:
+            self.num_pages = self.rows * self.nb
+            self.table_np = None
+            # the free-stack allocator starts EMPTY: bootstrap() claims
+            # the whole pool through the identity table, and churn begins
+            # at the first release
+            self._pstate = PageState(
+                free=jnp.arange(self.num_pages, dtype=jnp.int32),
+                top=jnp.asarray(0, jnp.int32),
+                table=full_table(self.rows, self.nb))
+
+        from nanorlhf_tpu.core.model import init_paged_kv_cache
+        caches0 = init_paged_kv_cache(
+            config, self.num_pages, self.page_size,
+            params["embed_tokens"].dtype)
+        R = self.rows
+        # empty carry: every row starts done; admit() installs rows
+        # through the same path mid-loop admissions use
+        base = (jnp.int32(1),
+                jnp.full((R, self.max_tokens), self.pad_token_id, jnp.int32),
+                jnp.zeros((R, self.max_tokens), jnp.float32),
+                caches0,
+                jnp.zeros((R, self.T_max), bool),
+                jnp.ones((R,), bool),
+                jnp.zeros((R,), jnp.int32),
+                jnp.ones((R,), jnp.int32),
+                jnp.zeros((R,), jnp.int32),
+                key)
+        if self.spec:
+            zero = jnp.int32(0)
+            base = base + (zero, zero, zero, zero,
+                           jnp.zeros((R,), jnp.int32))
+        self.state = base
+
+        self._sample_kw = dict(temperature=temperature, top_p=top_p,
+                               greedy=greedy, top_k=top_k,
+                               approx_top_k=approx_top_k)
+        self._statics = dict(
+            Tp=self.Tp, max_tokens=self.max_tokens, page_size=self.page_size,
+            sync_every=int(sync_every), eos_token_id=self.eos_token_id,
+            pad_token_id=self.pad_token_id, temperature=temperature,
+            top_p=top_p, greedy=greedy, lora_scale=lora_scale, top_k=top_k,
+            capture_logprobs=self.capture_logprobs,
+            approx_top_k=approx_top_k,
+        )
+        if self.spec:
+            self._statics.update(spec_k=self.spec_k,
+                                 spec_ngram=self.spec_ngram)
+
+        # per-row sampling params (serving mode): host-of-record arrays,
+        # uploaded as traced chunk arguments — the values the pre-session
+        # engine kept in carry slots 8–11
+        self._temp_np = np.ones((R,), np.float32)
+        self._topp_np = np.ones((R,), np.float32)
+        self._greedy_np = np.zeros((R,), bool)
+        self._budget_np = np.ones((R,), np.int32)
+
+        # speculative draft state: resident prompts + radix-seeded windows
+        self._prompt_res_np = np.full((R, self.Tp), self.pad_token_id,
+                                      np.int32)
+        self._prompt_rep = jnp.asarray(self._prompt_res_np)
+        self.seed_window = (self.max_tokens + self.spec_ngram
+                            if (self.spec and self._radix is not None) else 0)
+        if self.seed_window:
+            self._seed_np = np.full((R, self.seed_window), self.pad_token_id,
+                                    np.int32)
+            self._seed_len_np = np.zeros((R,), np.int32)
+            self._seed_rep = jnp.asarray(self._seed_np)
+            self._seed_len = jnp.asarray(self._seed_len_np)
+
+        self._kelems: list = [None] * R       # radix keys of resident rows
+        self._pending: list[_PendingPrefill] = []
+
+        # dispatch accounting (module docstring): launches = model
+        # forwards outside the decode/verify loop; decode iterations come
+        # from the carry's own counter
+        self.launches = 0
+        self.dispatch_tokens = 0
+        self.hit_tokens = 0
+        self.chunked_admissions = 0
+        self.backlog_peak = 0
+        self._it_prev = 0
+
+    # ------------------------------------------------------------- #
+    # admission
+    # ------------------------------------------------------------- #
+
+    def bootstrap(self, prompt_ids, prompt_mask):
+        """Batched initial admission for the non-radix rollout mode: one
+        `_prefill_state` over the first `rows` prompts, pool fully
+        claimed by the identity table — exactly the pre-session
+        scheduler's initial batch, which is what keeps its greedy streams
+        (and TTFT semantics) bit-identical. Never chunked: chunked
+        prefill protects RESIDENT rows' latency, and there are none yet."""
+        assert self._radix is None, "radix mode admits rows individually"
+        R = self.rows
+        t0 = time.perf_counter()
+        base = _prefill_state_jit(
+            self.params, self.config, prompt_ids[:R], prompt_mask[:R],
+            self._key, max_tokens=self.max_tokens,
+            eos_token_id=self.eos_token_id, pad_token_id=self.pad_token_id,
+            lora_scale=self.lora_scale,
+            capture_logprobs=self.capture_logprobs,
+            page_size=self.page_size, **self._sample_kw)
+        (_one, out0, lp0, caches, key_mask0, done0, tok0, plen0, _key) = base
+        self.launches += 1
+        self.dispatch_tokens += R * self.Tp
+        if self._hub is not None:
+            # every initial-batch row's first token exists once this
+            # prefill lands: one TTFT observation per admitted request
+            jax.block_until_ready(tok0)
+            ttft0 = time.perf_counter() - t0
+            for _ in range(R):
+                self._hub.record("latency/ttft_s", ttft0)
+        if self.spec:
+            from nanorlhf_tpu.sampler.speculative import _spec_state
+            self.state = _spec_state(base)
+        else:
+            self.state = (jnp.int32(1), out0, lp0, caches, key_mask0, done0,
+                          tok0, jnp.ones((R,), jnp.int32), plen0, self._key)
+        self._prompt_res_np[:] = np.asarray(prompt_ids[:R])
+        self._prompt_rep = jnp.asarray(self._prompt_res_np)
+        self._it_prev = int(self.state[0]) - 1
+
+    def admit(self, r: int, toks_np, mask_np, admit_index: int, *,
+              budget=None, temperature=None, top_p=None, greedy=None,
+              t_start=None):
+        """Admit one prompt into resident row `r`.
+
+        `admit_index` keys the admission PRNG fold
+        (`fold_in(admit_key, _ADMIT_BASE + admit_index)`) — the rollout
+        driver passes the queue index, the engine the request id.
+        Rollout mode ignores the per-request kwargs (sampling params are
+        session statics); serving mode requires `budget`.
+
+        Radix mode may raise RuntimeError (pool exhausted even after
+        eviction) BEFORE any row state changes — the engine sheds on it.
+
+        Returns the first token as a host int in per-row mode (the
+        engine streams it immediately), None in rollout mode (no forced
+        device sync), and None for a chunked admission in either mode
+        (the first token lands when the final chunk installs the row —
+        drivers must treat `is_pending(r)` rows as not-yet-done)."""
+        toks_np = np.asarray(toks_np, np.int32)
+        mask_np = np.asarray(mask_np, bool)
+        t0 = time.perf_counter() if t_start is None else t_start
+        pad_count = int(self.Tp - mask_np.sum())
+        a_key = jax.random.fold_in(self._admit_key, _ADMIT_BASE
+                                   + int(admit_index))
+
+        kelems = plan = seed = None
+        if self._radix is not None:
+            from nanorlhf_tpu.serving.radix import copy_page, prompt_key
+            kelems = prompt_key(toks_np, mask_np)
+            # may raise RuntimeError — before any state mutation
+            plan = self._radix.plan(kelems, pad_count=pad_count,
+                                    n_blocks=self.nb, prompt_len=self.Tp)
+            if self.seed_window:
+                seed = self._radix.matched_continuation(
+                    kelems, self.seed_window)
+            self.table_np[r] = plan.row_pages
+            if plan.cow_src is not None:
+                s = list(self.state)
+                s[3] = copy_page(s[3], plan.cow_src, plan.cow_dst)
+                self.state = tuple(s)
+            # per-row mode runs the unified suffix forward even on a cold
+            # miss (start = pad_count, pad KV never written); rollout mode
+            # keeps the cold full-row prefill so its streams stay
+            # bit-identical to the uncached scheduler
+            if plan.m > 0:
+                start = plan.m
+            elif self.per_row:
+                start = pad_count
+            else:
+                start = None
+        else:
+            self._pstate, ok = _alloc_jit(self._pstate, r, self.nb)
+            assert bool(ok), \
+                "allocator underflow: full-budget rows recycle uniformly"
+            start = None
+
+        row_table_np = None
+        if self._radix is None:
+            row_table_np = self._pstate.table[r]
+
+        pend = _PendingPrefill(
+            row=r, toks=toks_np, mask=mask_np, pad_count=pad_count,
+            next_slot=0, admit_key=a_key, t_start=t0, kelems=kelems,
+            plan_hit=(plan.hit_tokens if plan is not None else 0),
+            seed=seed, budget=budget,
+            temperature=(1.0 if temperature is None else float(temperature)),
+            top_p=(1.0 if top_p is None else float(top_p)),
+            greedy=bool(greedy), row_table=row_table_np)
+
+        if start is None:
+            # cold full-row prefill (rollout mode): identical to the
+            # uncached path, and — when chunking is on — chunked from the
+            # first REAL token through the same KV-only forwards
+            start_abs = pad_count
+            full_cold = True
+        else:
+            start_abs = start
+            full_cold = False
+        s_real = self.Tp - start_abs
+        C = self.prefill_chunk
+        if C > 0 and s_real > C:
+            pend.next_slot = start_abs
+            pend.meta["full_cold"] = full_cold
+            self._pending.append(pend)
+            self.backlog_peak = max(self.backlog_peak,
+                                    self._backlog_tokens())
+            self.chunked_admissions += 1
+            return None
+        return self._admit_now(pend, full_cold=full_cold,
+                               start_abs=start_abs)
+
+    def _admit_now(self, pend: _PendingPrefill, *, full_cold: bool,
+                   start_abs: int):
+        """Unchunked (or final-chunk-only) admission forward + install."""
+        from nanorlhf_tpu.serving.radix import bucket_len, suffix_logits
+        p = pend
+        caches = self.state[3]
+        row_table = (jnp.asarray(self.table_np[p.row])
+                     if self._radix is not None else p.row_table)
+        if full_cold and not self.per_row and self.prefill_chunk == 0:
+            # the pre-session cold path: one full-row prefill (pads
+            # included) — kept verbatim so rollout parity pins hold
+            caches, t0, l0, plen = _admit_one(
+                self.params, self.config, jnp.asarray(p.toks[None, :]),
+                jnp.asarray(p.mask[None, :]), caches, row_table,
+                p.admit_key, page_size=self.page_size, T_max=self.T_max,
+                lora_scale=self.lora_scale, **self._sample_kw)
+            self.dispatch_tokens += self.Tp
+        else:
+            s_real = self.Tp - start_abs
+            Sb = bucket_len(s_real, self.T_max - start_abs)
+            suffix = np.zeros((1, Sb), np.int32)
+            suffix[0, :s_real] = p.toks[start_abs:]
+            pos = ((start_abs - p.pad_count)
+                   + np.arange(Sb, dtype=np.int32)[None])
+            km = np.zeros((1, self.T_max), bool)
+            km[0, p.pad_count:start_abs] = True
+            logits, caches = suffix_logits(
+                self.params, self.config, jnp.asarray(suffix),
+                jnp.asarray(pos), jnp.asarray([start_abs], jnp.int32),
+                jnp.int32(s_real - 1), jnp.asarray(km), caches,
+                row_table, page_size=self.page_size,
+                lora_scale=self.lora_scale)
+            self.dispatch_tokens += Sb
+            self.hit_tokens += p.plan_hit
+            if self.per_row:
+                t0 = _first_token(
+                    logits, p.admit_key, jnp.float32(p.temperature),
+                    jnp.float32(p.top_p), jnp.asarray(p.greedy),
+                    top_k=self._sample_kw["top_k"],
+                    approx_top_k=self._sample_kw["approx_top_k"])
+                l0 = jnp.float32(0.0)
+            else:
+                t0, l0 = _admit_sample(logits, p.admit_key,
+                                       **self._sample_kw)
+            plen = jnp.int32(int(p.mask.sum()))
+        self.launches += 1
+        return self._install(p, caches, t0, l0, plen)
+
+    def _install(self, p: _PendingPrefill, caches, t0, l0, plen):
+        r = p.row
+        if self._radix is not None:
+            self._radix.insert(p.kelems, self.table_np[r], self.Tp)
+            self._kelems[r] = p.kelems
+        if self.per_row:
+            self._temp_np[r] = p.temperature
+            self._topp_np[r] = p.top_p
+            self._greedy_np[r] = p.greedy
+            self._budget_np[r] = int(p.budget)
+        if self.spec:
+            self._prompt_res_np[r] = p.toks
+            self._prompt_rep = jnp.asarray(self._prompt_res_np)
+            if self.seed_window:
+                W = self.seed_window
+                self._seed_np[r] = self.pad_token_id
+                n = 0 if p.seed is None else min(len(p.seed), W)
+                if n:
+                    self._seed_np[r, W - n:] = p.seed[:n]
+                self._seed_len_np[r] = n
+                self._seed_rep = jnp.asarray(self._seed_np)
+                self._seed_len = jnp.asarray(self._seed_len_np)
+        if self._hub is not None or self.per_row:
+            # t0 is the admission forward's sampled first token: blocking
+            # on it gives this request's true TTFT (and the engine needs
+            # the host int to stream it)
+            jax.block_until_ready(t0)
+        if self._hub is not None:
+            self._hub.record("latency/ttft_s",
+                             time.perf_counter() - p.t_start)
+        self.state = _install_row(
+            self.state, caches, r, t0, l0, jnp.asarray(p.mask), plen,
+            (jnp.int32(int(p.budget)) if self.per_row else None),
+            Tp=self.Tp, max_tokens=self.max_tokens,
+            eos_token_id=self.eos_token_id, pad_token_id=self.pad_token_id,
+            spec=self.spec, per_row=self.per_row)
+        return int(t0) if self.per_row else None
+
+    # ------------------------------------------------------------- #
+    # stepping
+    # ------------------------------------------------------------- #
+
+    def _prefill_tick(self):
+        """Advance the OLDEST pending chunked admission by exactly one
+        KV-only chunk forward; the final chunk (<= prefill_chunk real
+        tokens) runs the normal suffix+install path, with the SAME
+        admission PRNG fold as an unchunked admission — chunked-on/off
+        greedy streams are bit-identical (sampled rows decode at later
+        global folds, so they match in distribution only)."""
+        p = self._pending[0]
+        remaining = self.Tp - p.next_slot
+        C = self.prefill_chunk
+        if remaining <= C:
+            self._pending.pop(0)
+            tok0 = self._admit_now(p, full_cold=p.meta.get("full_cold",
+                                                           False),
+                                   start_abs=p.next_slot)
+            return (p.row, tok0)
+        chunk = p.toks[p.next_slot:p.next_slot + C][None, :]
+        pos = ((p.next_slot - p.pad_count)
+               + np.arange(C, dtype=np.int32)[None])
+        km = np.zeros((1, self.T_max), bool)
+        km[0, p.pad_count:p.next_slot] = True
+        row_table = (jnp.asarray(self.table_np[p.row])
+                     if self._radix is not None else p.row_table)
+        s = list(self.state)
+        s[3] = _prefill_chunk_fwd(
+            self.params, self.config, jnp.asarray(chunk), jnp.asarray(pos),
+            jnp.asarray([p.next_slot], jnp.int32), jnp.asarray(km), s[3],
+            row_table, page_size=self.page_size, lora_scale=self.lora_scale)
+        self.state = tuple(s)
+        p.next_slot += C
+        self.launches += 1
+        self.dispatch_tokens += C
+        return None
+
+    def step(self):
+        """One scheduler beat: at most one pending-prefill chunk, then
+        one decode (or draft+verify) chunk of up to `sync_every`
+        iterations. Returns (done_h, installed) — the host done flags
+        and the (row, first_token_or_None) of an admission whose final
+        chunk landed this beat, if any."""
+        installed = None
+        if self._pending:
+            installed = self._prefill_tick()
+        t0 = time.perf_counter()
+        table_dev = (jnp.asarray(self.table_np) if self._radix is not None
+                     else self._pstate.table)
+        if self.spec:
+            if self.seed_window:
+                self.state = _spec_chunk_seeded(
+                    self.params, self.config, self.state, table_dev,
+                    self._prompt_rep, self._seed_rep, self._seed_len,
+                    **self._statics)
+            else:
+                self.state = _spec_chunk(
+                    self.params, self.config, self.state, table_dev,
+                    self._prompt_rep, **self._statics)
+        elif self.per_row:
+            self.state = _serving_chunk(
+                self.params, self.config, self.state, table_dev,
+                jnp.asarray(self._temp_np), jnp.asarray(self._topp_np),
+                jnp.asarray(self._greedy_np), jnp.asarray(self._budget_np),
+                **self._statics)
+        else:
+            self.state = _decode_chunk(
+                self.params, self.config, self.state, table_dev,
+                **self._statics)
+        done_h = np.asarray(self.state[5])
+        it_now = int(self.state[0]) - 1
+        if self._hub is not None:
+            # done_h forced the device sync, so the chunk's wall time is
+            # fully realised here; one mean inter-token gap per sync
+            # chunk. The serving driver only records when the counter
+            # advanced (its loop also spins on admission-only beats).
+            if not self.per_row:
+                self._hub.record("latency/intertoken_s",
+                                 (time.perf_counter() - t0)
+                                 / max(1, it_now - self._it_prev))
+            elif it_now > self._it_prev:
+                self._hub.record("latency/intertoken_s",
+                                 (time.perf_counter() - t0)
+                                 / (it_now - self._it_prev))
+        self._it_prev = it_now
+        return done_h, installed
+
+    # ------------------------------------------------------------- #
+    # release / introspection
+    # ------------------------------------------------------------- #
+
+    def iterations(self) -> int:
+        """Decode/verify iterations so far (the carry's own counter)."""
+        return int(self.state[0]) - 1
+
+    def dispatch_events(self) -> int:
+        """Total model-forward launches: admission/chunk forwards plus
+        decode (or verify) iterations — the spec+radix A/B's unit."""
+        return self.launches + self.iterations()
+
+    def is_pending(self, r: int) -> bool:
+        return any(p.row == r for p in self._pending)
+
+    def pending_rows(self):
+        return {p.row for p in self._pending}
+
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    def _backlog_tokens(self) -> int:
+        return int(sum(self.Tp - p.next_slot for p in self._pending))
+
+    def release(self, r: int, gen_tokens=None) -> int:
+        """Release row `r`'s pages (radix: drop the ROW's refs — tree
+        refs survive as cached prefix KV; free-stack: push the row's
+        pages). When the drafter seed is active and `gen_tokens` (the
+        row's emitted tokens, EOS included) is given, the generated
+        continuation is appended to the radix tree as TEXT-ONLY nodes
+        (`RadixCache.extend_text`) so the next overlapping admission can
+        seed its n-gram window from it. Returns pages freed."""
+        if self._radix is not None:
+            if (self.seed_window and gen_tokens is not None
+                    and self._kelems[r] is not None):
+                ext = self._kelems[r] + tuple(
+                    int(t) * 2 + 1 for t in np.asarray(gen_tokens).ravel())
+                self._radix.extend_text(ext)
+            freed = self._radix.release(self.table_np[r])
+            self.table_np[r] = self.num_pages
+            self._kelems[r] = None
+            return freed
+        self._pstate, m = _release_jit(self._pstate, r)
+        return int(m)
+
+    def cancel_row(self, r: int) -> None:
+        """Serving-side reap: drop any pending chunked admission for the
+        row, force its done flag (the jitted chunk then skips it), and
+        free its pages — mirrors the completion path exactly so a
+        disconnect can never leak what a completion would have freed."""
+        self._pending = [p for p in self._pending if p.row != r]
+        s = list(self.state)
+        s[5] = s[5].at[r].set(True)
+        self.state = tuple(s)
+        self.release(r)
+
+    def utilization(self) -> float:
+        """Allocated / total pages right now."""
+        if self._radix is not None:
+            return 1.0 - self._radix.pool.free_count / self.num_pages
+        return 1.0 - float(np.asarray(self._pstate.top)) / self.num_pages
+
+    def shared_pages(self) -> int:
+        return (self._radix.pool.shared_count()
+                if self._radix is not None else 0)
+
+    def status(self) -> dict:
+        """JSON-able /statusz `session` section: resident rows, the
+        chunked-prefill backlog, and per-row feature flags."""
+        done_h = np.asarray(self.state[5])
+        pend = self.pending_rows()
+        return {
+            "rows": self.rows,
+            "live_rows": int((~done_h).sum()),
+            "mode": "serving" if self.per_row else "rollout",
+            "features": {
+                "spec_k": self.spec_k,
+                "prefix_cache": self._radix is not None,
+                "prefill_chunk": self.prefill_chunk,
+                "per_row_sampling": self.per_row,
+                "drafter_seed_window": self.seed_window,
+            },
+            "pending_prefill": {
+                "rows": sorted(pend),
+                "backlog_tokens": self._backlog_tokens(),
+            },
+            "row_flags": [
+                {"live": bool(not done_h[r]),
+                 "chunk_pending": r in pend,
+                 "seeded_draft_len": (int(self._seed_len_np[r])
+                                      if self.seed_window else 0)}
+                for r in range(self.rows)
+            ],
+            "counters": {
+                "launches": self.launches,
+                "decode_iterations": self.iterations(),
+                "dispatch_events": self.dispatch_events(),
+                "dispatch_tokens": self.dispatch_tokens,
+                "prefix_hit_tokens": self.hit_tokens,
+                "chunked_admissions": self.chunked_admissions,
+                "prefill_backlog_peak": self.backlog_peak,
+            },
+        }

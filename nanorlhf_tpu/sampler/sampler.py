@@ -86,7 +86,8 @@ class SamplingParams:
     # Mutually exclusive with spec_k > 0 AND with page_size > 0: compaction's
     # row gather assumes every live row sits at the same decode step (shared
     # cache-slot layout), which per-row accept lengths / per-row fill breaks
-    # — `generate` raises on either combination.
+    # — `compose_check` raises on either combination (the one legality
+    # matrix every decode entry point routes through).
     compaction_segments: int = 0
     # >0 switches the KV cache to the PAGED layout (sampler/paged/,
     # docs/PAGED_CACHE.md): K/V live in a global pool of page_size-token
@@ -132,6 +133,18 @@ class SamplingParams:
     # fewer but better drafts. 3 suits R1-style self-repetitive math
     # rollouts (restated problem text, \boxed{} scaffolding).
     spec_ngram: int = 3
+    # queued paged path only (page_size > 0 with decode_rows > 0): >0 splits
+    # any admission whose real prompt suffix exceeds this many tokens into
+    # KV-only chunk forwards interleaved with the resident rows' decode
+    # chunks (sampler/paged/session.py) — a long cold prompt no longer
+    # stalls every live stream for its full prefill, bounding the p95
+    # inter-token gap (bench detail.session gates it). GREEDY streams are
+    # bit-identical to prefill_chunk=0 (the final chunk runs the same
+    # bucketed suffix forward and samples from the same admission PRNG
+    # fold, test-pinned); sampled streams are equal in distribution only
+    # — a chunk-delayed row decodes at later global fold_in(key, it)
+    # iterations than it would unchunked. 0 = whole-suffix admission.
+    prefill_chunk: int = 0
     # n>1: prefill each prompt ONCE and fan the prompt KV out to its N
     # samples inside the jit, instead of repeating the prompt rows before
     # prefill — ÷N prefill FLOPs and prompt activation memory, the
@@ -146,6 +159,79 @@ class SamplingParams:
     # chip with `tools/ablate_decode.py` (the n4_shared vs n4_repeat
     # configs measure both the speedup and any stream divergence).
     shared_prompt_prefill: bool = True
+
+
+def compose_check(sampling: SamplingParams, *,
+                  prefix_cache: bool = False) -> None:
+    """THE decode-feature composition gate: raises ValueError on every
+    remaining-illegal combination, with the reason. Every entry point that
+    assembles decode features (generate() below, the trainer's config
+    validation) routes through this one function, so the legality matrix
+    lives in exactly one place.
+
+    Since the decode-session refactor (sampler/paged/session.py) the
+    features compose by default — spec decode under the radix prefix
+    cache, chunked prefill under either, serving's per-row sampling on
+    the same loop (docs/PAGED_CACHE.md has the full feature×feature
+    matrix). What remains illegal, and why:
+
+      * compaction_segments > 0 with page_size > 0 — compaction is the
+        legacy contiguous-layout straggler lever; its between-segment row
+        gather assumes per-row [T_max] slabs, which the paged layout's
+        block-table indirection doesn't have. The paged cache with
+        decode_rows > 0 is its replacement, not its peer.
+      * compaction_segments > 0 with spec_k > 0 — the gather also assumes
+        every live row sits at the same decode step (shared cache-slot
+        layout), which per-row accept lengths break.
+      * prefix_cache without continuous batching (page_size > 0 AND
+        decode_rows > 0) — the radix cache lives at the ADMISSION point;
+        the monolithic one-jit paths prefill the whole batch at trace
+        time and have no admission to cache across.
+      * prefill_chunk > 0 without continuous batching — chunked prefill
+        exists to protect RESIDENT rows' inter-token cadence during a
+        long admission; the monolithic paths have neither residents nor
+        admissions.
+
+    Per-row serving constraints (spec requires static greedy, no logprob
+    capture) are enforced by DecodeSession's constructor — they depend on
+    the per_row flag the engine sets, not on SamplingParams."""
+    if sampling.page_size > 0 and sampling.compaction_segments > 0:
+        raise ValueError(
+            "page_size > 0 is incompatible with compaction_segments > 0: "
+            "compaction is the legacy contiguous-layout straggler lever "
+            "(same-step row gathers over per-row slabs), and the paged "
+            "cache replaces it outright — set decode_rows > 0 for true "
+            "continuous batching over recycled pages instead of batch "
+            "shrinking (sampler/paged/scheduler.py)."
+        )
+    if sampling.spec_k > 0 and sampling.compaction_segments > 0:
+        raise ValueError(
+            "spec_k > 0 is incompatible with compaction_segments > 0: "
+            "compacting decode gathers rows under the assumption that "
+            "every live row sits at the same decode step (shared "
+            "cache-slot layout, sampler/compaction.py), which "
+            "speculative decode's per-row accept lengths break. "
+            "Compaction is legacy — the preferred straggler fix is the "
+            "paged cache (SamplingParams.page_size > 0 with "
+            "decode_rows > 0), whose continuous batching COMPOSES with "
+            "spec_k instead of excluding it."
+        )
+    queued_capable = sampling.page_size > 0 and sampling.decode_rows > 0
+    if prefix_cache and not queued_capable:
+        raise ValueError(
+            "prefix_cache requires continuous batching: set page_size > 0 "
+            "and decode_rows > 0 (rollout_page_size / rollout_decode_rows "
+            "on the trainer) — the monolithic paths have no admission "
+            "point to cache across."
+        )
+    if sampling.prefill_chunk > 0 and not queued_capable:
+        raise ValueError(
+            "prefill_chunk > 0 requires continuous batching: set "
+            "page_size > 0 and decode_rows > 0 — chunked prefill "
+            "interleaves a long admission with RESIDENT rows' decode "
+            "chunks, and the monolithic paths have neither residents nor "
+            "mid-loop admissions to protect."
+        )
 
 
 def top_p_filter(logits: jnp.ndarray, top_p: float) -> jnp.ndarray:
@@ -538,7 +624,12 @@ def generate(
     and only the suffix is prefilled (serving/radix.py). The cache resets
     per call (KV is tied to params), so within a rollout the win comes
     from the n>1 fanout and repeated dataset prompts. Ignored by the
-    non-queued paths; incompatible with spec_k > 0."""
+    non-queued paths; COMPOSES with spec_k > 0 (the drafter seeds its
+    lookup window from the cached continuation — see compose_check for
+    the full legality matrix)."""
+    compose_check(sampling, prefix_cache=(
+        prefix_cache is not None
+        and getattr(prefix_cache, "enabled", False)))
     total_rows = prompt_ids.shape[0] * sampling.n
     queued = (sampling.page_size > 0 and sampling.decode_rows > 0
               and sampling.decode_rows < total_rows)
@@ -552,15 +643,6 @@ def generate(
             # fan-out there, each logical row becomes its own queue entry
             prompt_ids = jnp.repeat(prompt_ids, sampling.n, axis=0)
             prompt_mask = jnp.repeat(prompt_mask, sampling.n, axis=0)
-    if sampling.page_size > 0 and sampling.compaction_segments > 0:
-        raise ValueError(
-            "page_size > 0 is incompatible with compaction_segments > 0: "
-            "compaction is the legacy contiguous-layout straggler lever "
-            "(same-step row gathers over per-row slabs), and the paged "
-            "cache replaces it outright — set decode_rows > 0 for true "
-            "continuous batching over recycled pages instead of batch "
-            "shrinking (sampler/paged/scheduler.py)."
-        )
     if queued:
         from nanorlhf_tpu.sampler.paged.scheduler import generate_tokens_queued
 
@@ -574,22 +656,11 @@ def generate(
             greedy=sampling.greedy, lora_scale=lora_scale,
             top_k=sampling.top_k, capture_logprobs=sampling.capture_logprobs,
             approx_top_k=sampling.approx_top_k,
+            prefill_chunk=sampling.prefill_chunk,
             spec_stats_out=spec_stats_out, paged_stats_out=paged_stats_out,
             latency=latency, prefix_cache=prefix_cache,
         )
     if sampling.spec_k > 0:
-        if sampling.compaction_segments > 0:
-            raise ValueError(
-                "spec_k > 0 is incompatible with compaction_segments > 0: "
-                "compacting decode gathers rows under the assumption that "
-                "every live row sits at the same decode step (shared "
-                "cache-slot layout, sampler/compaction.py), which "
-                "speculative decode's per-row accept lengths break. "
-                "Compaction is legacy — the preferred straggler fix is the "
-                "paged cache (SamplingParams.page_size > 0 with "
-                "decode_rows > 0), whose continuous batching COMPOSES with "
-                "spec_k instead of excluding it."
-            )
         from nanorlhf_tpu.sampler.speculative import generate_spec
 
         result = generate_spec(

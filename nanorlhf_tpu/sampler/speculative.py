@@ -180,13 +180,39 @@ def accept_candidates(logits, drafts, step_key, *, temperature, top_p, top_k,
     return emitted, acc
 
 
-def _draft_fn(prompt_rep, state, *, Tp, spec_k, spec_ngram, pad_token_id):
+def _draft_fn(prompt_rep, state, *, Tp, spec_k, spec_ngram, pad_token_id,
+              seed_rep=None, seed_len=None):
     """Draft step over the carry: build the prompt+output buffer and
-    propose spec_k tokens per row."""
+    propose spec_k tokens per row.
+
+    `seed_rep` ([R, W] int32, right-aligned) / `seed_len` ([R] int32),
+    when given, prepend a per-row SEED window to the lookup buffer — the
+    radix-matched cached continuation the decode session installs at
+    admission (sampler/paged/session.py), which fixes the drafter's
+    cold-start blind spot: without it the n-gram match only sees the
+    row's OWN prompt+output, so prefix-heavy corpora draft nothing until
+    the row has repeated itself. Rows with `seed_len == 0` keep exactly
+    the unseeded valid range (shifted by the constant W, which the match
+    positions are relative to, so proposals are unchanged). The pad gap
+    between a row's seed tail and its first real prompt token stays
+    INSIDE the valid range — a window straddling it only matches when
+    the row's recent output equals pad runs, which live rows never emit,
+    and a junk draft merely gets rejected by verification (greedy output
+    is draft-independent either way)."""
     out, done, n_gen, prompt_len = state[1], state[5], state[7], state[8]
-    buf = jnp.concatenate([prompt_rep, out], axis=1)
+    if seed_rep is None:
+        buf = jnp.concatenate([prompt_rep, out], axis=1)
+        drafts, _ = ngram_propose(
+            buf, Tp + n_gen, Tp - prompt_len, k=spec_k, m=spec_ngram,
+            pad_token_id=pad_token_id,
+        )
+        return drafts
+    W = seed_rep.shape[1]
+    buf = jnp.concatenate([seed_rep, prompt_rep, out], axis=1)
+    valid_start = jnp.where(seed_len > 0, W - seed_len,
+                            W + Tp - prompt_len)
     drafts, _ = ngram_propose(
-        buf, Tp + n_gen, Tp - prompt_len, k=spec_k, m=spec_ngram,
+        buf, W + Tp + n_gen, valid_start, k=spec_k, m=spec_ngram,
         pad_token_id=pad_token_id,
     )
     return drafts

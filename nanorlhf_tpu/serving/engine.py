@@ -3,38 +3,45 @@
 The rollout scheduler (`sampler/paged/scheduler.py`) serves a CLOSED
 queue — every prompt is known up front and the call returns when the
 queue drains. This module reshapes the same machinery into an OPEN
-server loop for interactive traffic:
+server loop for interactive traffic. Since the decode-session refactor
+the engine owns NO decode loop of its own: it constructs a
+`sampler.paged.session.DecodeSession` in per-row mode and every request
+flows through the same jitted chunk body, admission path, and release
+path the rollout scheduler drives — one scheduler code path for gateway
+streams and rollout (test-pinned bit-identical to the pre-session
+engine). What remains here is open-loop POLICY:
 
-  * A fixed-shape jitted decode chunk over `rows` resident rows, like
-    the scheduler's `_decode_chunk`, but with PER-REQUEST sampling
-    params carried as traced `[R]` arrays (`temperature`, `top_p`,
-    `greedy`, token `budget`) instead of static scalars — one compiled
-    program serves any mix of greedy and sampled requests.
-  * Admission through one `RadixCache` kept alive for the engine's
-    whole lifetime (params are fixed, so cached KV never goes stale):
-    a request's matched prefix installs refcount-shared pages with zero
-    prefill FLOPs and only the suffix runs through `suffix_logits`.
-    Cold admissions take the same path with an empty match — the
-    suffix forward starts at the first real token (`fill = pad_count`),
-    so pad KV is never written (and never read: `key_mask` excludes
-    pad slots).
   * SLO-aware shed-vs-admit: `submit()` rejects when the pending queue
     is full or when the LatencyHub's p95 TTFT is over the
     `slo_ttft_p95` rule's warn threshold (telemetry/health.py) — the
     same rule the health monitor pages on, so the gateway starts
     shedding exactly when the alert would fire.
-  * Per-request TTFT (submit → first token ready, blocking on the
-    admission forward) and per-chunk mean inter-token gaps stream into
-    the attached LatencyHub under the PR 13 metric names.
+  * Request lifecycle: per-request sampling params ride the session's
+    traced [R] arrays (one compiled decode program serves any mix of
+    greedy and sampled requests), tokens stream out through per-request
+    queues, cancelled rows are reaped with their pages freed.
+  * Composition inherited from the session: `prefill_chunk > 0` chunks
+    long cold admissions so resident streams keep their inter-token
+    cadence while a long prompt prefills; `spec_k > 0` runs draft+verify
+    chunks (greedy requests with the full token budget only — the
+    accept rule compiles against static sampling params; see
+    `sampler.compose_check`).
 
-Threading: one background loop thread owns the carry, the block table,
-and all device dispatch. `submit()` only appends to the pending deque
-under `make_condition("serving.engine")`; the one extracted lock edge is
-serving.engine -> telemetry.hist (the shed check reads hub quantiles
-under the condition). Radix plan/insert run OUTSIDE the condition, but
-"serving.engine" is still ranked above "serving.radix" in LOCK_ORDER so
-a future admission that does hold both stays deadlock-free by
-construction.
+Admission through one `RadixCache` kept alive for the engine's whole
+lifetime (params are fixed, so cached KV never goes stale): a request's
+matched prefix installs refcount-shared pages with zero prefill FLOPs
+and only the suffix runs through `suffix_logits`. Cold admissions take
+the same path with an empty match — the suffix forward starts at the
+first real token, so pad KV is never written (and never read).
+
+Threading: one background loop thread owns the session (carry, block
+table, all device dispatch). `submit()` only appends to the pending
+deque under `make_condition("serving.engine")`; the one extracted lock
+edge is serving.engine -> telemetry.hist (the shed check reads hub
+quantiles under the condition). Radix plan/insert run OUTSIDE the
+condition, but "serving.engine" is still ranked above "serving.radix"
+in LOCK_ORDER so a future admission that does hold both stays
+deadlock-free by construction.
 """
 
 from __future__ import annotations
@@ -45,137 +52,15 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from nanorlhf_tpu.analysis.lockorder import make_condition
-from nanorlhf_tpu.core.model import decode_step, init_paged_kv_cache
-from nanorlhf_tpu.ops.masking import guard_temperature
-from nanorlhf_tpu.sampler.paged.pages import blocks_per_row
-from nanorlhf_tpu.sampler.sampler import _nucleus_candidates
-from nanorlhf_tpu.serving.radix import (
-    RadixCache, bucket_len, copy_page, prompt_key, suffix_logits,
-)
+from nanorlhf_tpu.sampler.paged.session import DecodeSession
+from nanorlhf_tpu.serving.radix import RadixCache, prompt_key
 from nanorlhf_tpu.telemetry.health import SLO_RULES
-
-# admission PRNG folds live far from the per-iteration decode stream,
-# mirroring the scheduler's convention
-_ADMIT_BASE = 10_000_000
-
-
-def _serving_sample(key, logits, temperature, top_p, greedy, *, top_k,
-                    approx_top_k):
-    """Per-ROW sampling: `sampler._sample_token` with `temperature` /
-    `top_p` / `greedy` as traced `[R]` arrays so one compiled decode
-    step serves heterogeneous requests. Both branches are computed and
-    selected with `jnp.where(greedy, ...)`; the nucleus keep rule
-    broadcasts `top_p[:, None]` against the `[R, K]` candidate set.
-    Unlike the rollout sampler there is no exact full-vocab escape for
-    `top_p >= 1` — serving always samples in top-k candidate space
-    (`top_p = 1` keeps every candidate), which is the usual serving
-    trade and keeps the row-mixed program shape fixed."""
-    scaled = (logits.astype(jnp.float32)
-              / guard_temperature(temperature)[:, None])
-    top_logits, top_idx, keep = _nucleus_candidates(
-        scaled, top_p[:, None], top_k, approx_top_k)
-    kept = jnp.where(keep, top_logits, -jnp.inf)
-    choice = jax.random.categorical(key, kept, axis=-1)
-    sampled = jnp.take_along_axis(
-        top_idx, choice[..., None], axis=-1)[..., 0]
-    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
-                     sampled).astype(jnp.int32)
-
-
-@partial(jax.jit, static_argnames=("top_k", "approx_top_k"))
-def _first_token(logits, key, temperature, top_p, greedy, *, top_k,
-                 approx_top_k):
-    """Sample one admission's first token from its suffix logits [V]."""
-    return _serving_sample(key, logits[None, :], temperature[None],
-                           top_p[None], greedy[None], top_k=top_k,
-                           approx_top_k=approx_top_k)[0]
-
-
-# carry slots: 0 it · 1 out · 2 caches · 3 key_mask · 4 done · 5 cur_tok
-# · 6 n_gen · 7 prompt_len · 8 temperature · 9 top_p · 10 greedy ·
-# 11 budget · 12 key
-def _engine_decode_body(params, config, s, table, *, Tp, max_new,
-                        page_size, eos_token_id, pad_token_id, lora_scale,
-                        top_k, approx_top_k):
-    (it, out, caches, key_mask, done, cur_tok, n_gen, plen, temp, topp,
-     greedy, budget, key) = s
-    R = cur_tok.shape[0]
-    rows = jnp.arange(R)
-    slot = Tp + n_gen - 1
-    key_mask = key_mask.at[rows, slot].set(True)
-    position = plen + n_gen - 1
-    logits, caches = decode_step(
-        params, config, cur_tok, position, slot, key_mask, caches,
-        lora_scale=lora_scale, page_table=table, page_size=page_size,
-    )
-    tok = _serving_sample(jax.random.fold_in(key, it), logits, temp, topp,
-                          greedy, top_k=top_k, approx_top_k=approx_top_k)
-    tok = jnp.where(done, pad_token_id, tok)
-    live = ~done
-    wpos = jnp.where(live, n_gen, max_new)     # done rows drop their write
-    out = out.at[rows, wpos].set(tok, mode="drop")
-    cur_tok = jnp.where(live, tok, cur_tok)
-    n_gen = n_gen + live.astype(jnp.int32)
-    done = done | (tok == eos_token_id) | (n_gen >= budget)
-    return (it + 1, out, caches, key_mask, done, cur_tok, n_gen, plen,
-            temp, topp, greedy, budget, key)
-
-
-_ENGINE_STATIC = ("config", "Tp", "max_new", "page_size", "sync_every",
-                  "eos_token_id", "pad_token_id", "lora_scale", "top_k",
-                  "approx_top_k")
-
-
-@partial(jax.jit, static_argnames=_ENGINE_STATIC)
-def _engine_chunk(params, config, state, table, **statics):
-    """Up to `sync_every` decode iterations; exits once every row is
-    done, so the iteration counter counts true decode dispatches."""
-    sync_every = statics.pop("sync_every")
-
-    def cond(cs):
-        c, s = cs
-        return (c < sync_every) & ~jnp.all(s[4])
-
-    def body(cs):
-        c, s = cs
-        return c + 1, _engine_decode_body(params, config, s, table,
-                                          **statics)
-
-    _, state = jax.lax.while_loop(cond, body, (jnp.int32(0), state))
-    return state
-
-
-@partial(jax.jit, static_argnames=("Tp", "max_new", "eos_token_id",
-                                   "pad_token_id"))
-def _engine_install(state, caches, r, tok0, pmask_row, plen, temp, topp,
-                    greedy, budget, *, Tp, max_new, eos_token_id,
-                    pad_token_id):
-    """Reset carry row `r` for a freshly admitted request (post-suffix-
-    prefill values, per-request sampling params into the [R] arrays)."""
-    s = list(state)
-    T_mask = s[3].shape[1]
-    s[2] = caches
-    s[1] = s[1].at[r].set(
-        jnp.full((max_new,), pad_token_id, jnp.int32).at[0].set(tok0))
-    s[3] = s[3].at[r].set(
-        jnp.zeros((T_mask,), bool).at[:Tp].set(pmask_row))
-    s[4] = s[4].at[r].set((tok0 == eos_token_id) | (budget <= 1))
-    s[5] = s[5].at[r].set(tok0)
-    s[6] = s[6].at[r].set(jnp.int32(1))
-    s[7] = s[7].at[r].set(plen)
-    s[8] = s[8].at[r].set(temp)
-    s[9] = s[9].at[r].set(topp)
-    s[10] = s[10].at[r].set(greedy)
-    s[11] = s[11].at[r].set(budget)
-    return tuple(s)
 
 
 @dataclass
@@ -193,6 +78,7 @@ class ServingRequest:
     out_q: "queue.Queue" = field(default_factory=queue.Queue)
     n_emitted: int = 0
     cancelled: bool = False       # set by cancel(); loop reaps the row
+    kelems: Optional[tuple] = None
 
 
 class ServingEngine:
@@ -201,13 +87,17 @@ class ServingEngine:
     `prompt_len` / `max_new_tokens` fix the compiled shapes (prompts are
     left-padded to `prompt_len`; longer prompts are rejected at submit).
     `slo_warn_ttft_s=None` reads the warn threshold, quantile, and
-    warmup from the `slo_ttft_p95` rule in telemetry.health.SLO_RULES."""
+    warmup from the `slo_ttft_p95` rule in telemetry.health.SLO_RULES.
+    `prefill_chunk > 0` splits long cold admissions into that many
+    prompt tokens per decode chunk; `spec_k > 0` turns on n-gram
+    speculative decode (greedy, full-budget requests only)."""
 
     def __init__(self, params, config, *, eos_token_id, pad_token_id,
                  page_size=16, prompt_len=32, max_new_tokens=32, rows=2,
                  headroom=1.0, sync_every=4, max_queue=64, latency=None,
                  lora_scale=1.0, top_k=64, approx_top_k=True, seed=0,
-                 slo_warn_ttft_s: Optional[float] = None):
+                 slo_warn_ttft_s: Optional[float] = None,
+                 prefill_chunk=0, spec_k=0, spec_ngram=3):
         self.params = params
         self.config = config
         self.eos_token_id = int(eos_token_id)
@@ -218,9 +108,8 @@ class ServingEngine:
         self.rows = int(rows)
         self.sync_every = int(sync_every)
         self.max_queue = int(max_queue)
-        self.lora_scale = float(lora_scale)
-        self.top_k = int(top_k)
-        self.approx_top_k = bool(approx_top_k)
+        self.prefill_chunk = int(prefill_chunk)
+        self.spec_k = int(spec_k)
 
         rule = next(r for r in SLO_RULES if r.name == "slo_ttft_p95")
         self._slo_metric = rule.metric
@@ -232,40 +121,25 @@ class ServingEngine:
         self._hub = latency if (latency is not None
                                 and latency.enabled) else None
 
-        self.T_max = self.prompt_len + self.max_new_tokens
-        self.nb = blocks_per_row(self.T_max, self.page_size)
         self._radix = RadixCache(headroom=headroom)
-        self.num_pages = (self.rows * self.nb
-                          + self._radix.extra_pages(self.rows, self.nb))
-        self._radix.reset(num_pages=self.num_pages,
-                          page_size=self.page_size)
+        # the session sizes the pool (rows * nb + radix headroom), resets
+        # the tree ONCE here, and keeps it warm for the engine's lifetime
+        self._sess = DecodeSession(
+            params, config, rows=self.rows, prompt_len=self.prompt_len,
+            max_tokens=self.max_new_tokens, page_size=self.page_size,
+            eos_token_id=self.eos_token_id, pad_token_id=self.pad_token_id,
+            key=jax.random.PRNGKey(seed),
+            admit_key=jax.random.PRNGKey(seed + 1),
+            greedy=(self.spec_k > 0), top_k=int(top_k),
+            approx_top_k=bool(approx_top_k), lora_scale=float(lora_scale),
+            per_row=True, spec_k=self.spec_k, spec_ngram=int(spec_ngram),
+            prefix_cache=self._radix, prefill_chunk=self.prefill_chunk,
+            sync_every=self.sync_every, latency=self._hub)
+        self.T_max = self._sess.T_max
+        self.nb = self._sess.nb
+        self.num_pages = self._sess.num_pages
 
-        R, Tp, mx = self.rows, self.prompt_len, self.max_new_tokens
-        caches0 = init_paged_kv_cache(
-            config, self.num_pages, self.page_size,
-            params["embed_tokens"].dtype)
-        self._state = (jnp.int32(1),
-                       jnp.full((R, mx), self.pad_token_id, jnp.int32),
-                       caches0,
-                       jnp.zeros((R, self.T_max), bool),
-                       jnp.ones((R,), bool),
-                       jnp.zeros((R,), jnp.int32),
-                       jnp.ones((R,), jnp.int32),
-                       jnp.zeros((R,), jnp.int32),
-                       jnp.ones((R,), jnp.float32),
-                       jnp.ones((R,), jnp.float32),
-                       jnp.zeros((R,), bool),
-                       jnp.ones((R,), jnp.int32),
-                       jax.random.PRNGKey(seed))
-        self._key = jax.random.PRNGKey(seed + 1)
-        self._table = np.full((R, self.nb), self.num_pages, np.int32)
-        self._owner: list = [None] * R           # row -> ServingRequest
-        self._statics = dict(
-            Tp=Tp, max_new=mx, page_size=self.page_size,
-            sync_every=self.sync_every, eos_token_id=self.eos_token_id,
-            pad_token_id=self.pad_token_id, lora_scale=self.lora_scale,
-            top_k=self.top_k, approx_top_k=self.approx_top_k,
-        )
+        self._owner: list = [None] * self.rows   # row -> ServingRequest
 
         self._cond = make_condition("serving.engine")
         self._pending: deque = deque()
@@ -279,8 +153,6 @@ class ServingEngine:
         # scrape — dashboards can alert on rate() without init gaps
         self._shed_reasons = {"queue_full": 0, "slo_ttft_p95": 0,
                               "closed": 0, "pool": 0, "disconnect": 0}
-        self._dispatch_tokens = 0
-        self._it_prev = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="serving-engine", daemon=True)
         self._thread.start()
@@ -302,6 +174,12 @@ class ServingEngine:
                 " — the engine's compiled prompt shape is fixed")
         mx = self.max_new_tokens if max_tokens is None else int(max_tokens)
         mx = max(1, min(mx, self.max_new_tokens))
+        if self.spec_k > 0 and (not greedy or mx != self.max_new_tokens):
+            raise ValueError(
+                "a spec-decode engine (spec_k > 0) serves greedy requests "
+                "with the full token budget only: the verify/accept rule "
+                "compiles against static sampling params — see "
+                "sampler.compose_check")
         with self._cond:
             self._counters["requests"] += 1
             reason = self._shed_reason_locked()
@@ -336,9 +214,9 @@ class ServingEngine:
         for this request and free its resources — a dead socket must not
         keep a row decoding or pin its KV pages. Still-pending requests
         are shed immediately (reason "disconnect"); an admitted row is
-        reaped by the loop thread — which owns the block table and radix
-        refcounts — on its next iteration, counting into `cancelled`
-        (admitted == completed + cancelled at quiescence). Idempotent."""
+        reaped by the loop thread — which owns the session — on its next
+        iteration, counting into `cancelled` (admitted == completed +
+        cancelled at quiescence). Idempotent."""
         was_pending = False
         with self._cond:
             if req.cancelled:
@@ -370,7 +248,7 @@ class ServingEngine:
             yield tok
 
     # ------------------------------------------------------------- #
-    # engine loop (single background thread owns all device state)
+    # engine loop (single background thread owns the session)
     # ------------------------------------------------------------- #
 
     def _loop(self):
@@ -394,24 +272,23 @@ class ServingEngine:
             self._reap_cancelled()
             if all(o is None for o in self._owner):
                 continue
-            t0 = time.perf_counter()
-            self._state = _engine_chunk(
-                self.params, self.config, self._state,
-                jnp.asarray(self._table), **self._statics)
-            self._deliver(t0)
+            self._sess.step()
+            self._deliver()
 
     def _admit(self, r: int, req: ServingRequest):
-        Tp, P = self.prompt_len, self.page_size
+        Tp = self.prompt_len
         n = int(req.tokens.size)
         pad_count = Tp - n
         toks_p = np.full(Tp, self.pad_token_id, np.int32)
         toks_p[pad_count:] = req.tokens
         mask = np.zeros(Tp, bool)
         mask[pad_count:] = True
-        kelems = prompt_key(toks_p, mask)
+        req.kelems = prompt_key(toks_p, mask)
         try:
-            plan = self._radix.plan(kelems, pad_count=pad_count,
-                                    n_blocks=self.nb, prompt_len=Tp)
+            tok0 = self._sess.admit(
+                r, toks_p, mask, req.request_id, budget=req.max_tokens,
+                temperature=req.temperature, top_p=req.top_p,
+                greedy=req.greedy, t_start=req.t_submit)
         except RuntimeError:
             # pool sizing makes this unreachable (rows*nb live refs max,
             # the rest evictable) — shed rather than crash if it fires
@@ -422,86 +299,42 @@ class ServingEngine:
                 self._n_active -= 1
             req.out_q.put(None)
             return
-        self._table[r] = plan.row_pages
-        caches = self._state[2]
-        if plan.cow_src is not None:
-            caches = copy_page(caches, plan.cow_src, plan.cow_dst)
-        # unified suffix forward: a cold admission is just an empty match
-        # — fill starts at the first REAL token, so pad KV never exists
-        start = plan.m if plan.m > 0 else pad_count
-        s_real = Tp - start
-        Sb = bucket_len(s_real, self.T_max - start)
-        suffix = np.zeros((1, Sb), np.int32)
-        suffix[0, :s_real] = toks_p[start:]
-        pos = (start - pad_count) + np.arange(Sb, dtype=np.int32)[None]
-        km = np.zeros((1, self.T_max), bool)
-        km[0, pad_count:start] = True
-        logits, caches = suffix_logits(
-            self.params, self.config, jnp.asarray(suffix),
-            jnp.asarray(pos), jnp.asarray([start], jnp.int32),
-            jnp.int32(s_real - 1), jnp.asarray(km), caches,
-            jnp.asarray(plan.row_pages), page_size=P,
-            lora_scale=self.lora_scale)
-        self._dispatch_tokens += Sb
-        tok0 = _first_token(
-            logits,
-            jax.random.fold_in(self._key, _ADMIT_BASE + req.request_id),
-            jnp.float32(req.temperature), jnp.float32(req.top_p),
-            jnp.asarray(req.greedy), top_k=self.top_k,
-            approx_top_k=self.approx_top_k)
-        self._state = _engine_install(
-            self._state, caches, r, tok0, jnp.asarray(mask),
-            jnp.int32(n), jnp.float32(req.temperature),
-            jnp.float32(req.top_p), jnp.asarray(req.greedy),
-            jnp.int32(req.max_tokens), Tp=Tp, max_new=self.max_new_tokens,
-            eos_token_id=self.eos_token_id,
-            pad_token_id=self.pad_token_id)
-        self._radix.insert(kelems, plan.row_pages, Tp)
         self._owner[r] = req
-        jax.block_until_ready(tok0)
-        if self._hub is not None:
-            self._hub.record("latency/ttft_s",
-                             time.perf_counter() - req.t_submit)
         with self._cond:
             self._counters["admitted"] += 1
+        if tok0 is None:
+            # chunked admission: the first token lands when the final
+            # chunk installs the row; _deliver streams it from the carry
+            return
         req.out_q.put(int(tok0))
         req.n_emitted = 1
 
     def _reap_cancelled(self):
-        """Loop-thread only: free rows whose owner was cancelled. Forcing
-        the done flag makes the jitted chunk skip the row; the page
-        release mirrors _deliver's completion path exactly, so a
+        """Loop-thread only: free rows whose owner was cancelled. The
+        session forces the done flag (the jitted chunk then skips the
+        row) and releases pages exactly as a completion would, so a
         disconnect can never leak what a completion would have freed."""
         for r in range(self.rows):
             req = self._owner[r]
             if req is None or not req.cancelled:
                 continue
-            self._radix.release(self._table[r])
-            self._table[r] = self.num_pages
+            self._sess.cancel_row(r)
             self._owner[r] = None
-            s = list(self._state)
-            s[4] = s[4].at[r].set(True)
-            self._state = tuple(s)
             req.out_q.put(None)
             with self._cond:
                 self._counters["cancelled"] += 1
                 self._n_active -= 1
                 self._cond.notify_all()
 
-    def _deliver(self, t_chunk0: float):
-        state = self._state
-        done_h = np.asarray(state[4])
+    def _deliver(self):
+        state = self._sess.state
+        done_h = np.asarray(state[5])
         out_h = np.asarray(state[1])
-        n_gen_h = np.asarray(state[6])
-        it_now = int(state[0]) - 1
-        if self._hub is not None and it_now > self._it_prev:
-            self._hub.record("latency/intertoken_s",
-                             (time.perf_counter() - t_chunk0)
-                             / (it_now - self._it_prev))
-        self._it_prev = it_now
+        n_gen_h = np.asarray(state[7])
+        pending = self._sess.pending_rows()
         for r in range(self.rows):
             req = self._owner[r]
-            if req is None:
+            if req is None or r in pending:
                 continue
             n = int(n_gen_h[r])
             for tok in out_h[r, req.n_emitted:n]:
@@ -509,8 +342,9 @@ class ServingEngine:
             req.n_emitted = n
             if done_h[r]:
                 req.out_q.put(None)
-                self._radix.release(self._table[r])
-                self._table[r] = self.num_pages
+                self._sess.release(
+                    r, gen_tokens=(out_h[r, :n] if self.spec_k > 0
+                                   else None))
                 self._owner[r] = None
                 with self._cond:
                     self._counters["completed"] += 1
@@ -549,7 +383,7 @@ class ServingEngine:
             "serving/prefix_hit_frac": snap["hit_frac"],
             "serving/cow_splits": snap["cow_splits"],
             "serving/evicted_pages": snap["evicted_pages"],
-            "serving/prefill_token_dispatch": self._dispatch_tokens,
+            "serving/prefill_token_dispatch": self._sess.dispatch_tokens,
             "pages/shared": snap["shared_pages"],
         }
         for reason, n in sorted(reasons.items()):
@@ -558,7 +392,8 @@ class ServingEngine:
 
     def snapshot(self) -> dict:
         """JSON-able /statusz section: engine shape + live occupancy +
-        the radix tree's own snapshot under `prefix_cache`."""
+        the radix tree's own snapshot under `prefix_cache` + the decode
+        session's row/backlog/feature view under `session`."""
         with self._cond:
             c = dict(self._counters)
             reasons = dict(self._shed_reasons)
@@ -574,15 +409,20 @@ class ServingEngine:
             "num_pages": self.num_pages,
             "counters": c,
             "shed_reasons": reasons,
-            "prefill_token_dispatch": self._dispatch_tokens,
+            "prefill_token_dispatch": self._sess.dispatch_tokens,
             "slo": {"rule": "slo_ttft_p95", "warn_s": self._slo_warn,
                     "quantile": self._slo_q, "warmup": self._slo_warmup},
             "prefix_cache": self._radix.snapshot(),
+            "session": self._sess.status(),
         }
 
     @property
     def radix(self) -> RadixCache:
         return self._radix
+
+    @property
+    def session(self) -> DecodeSession:
+        return self._sess
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop admitting, drain active rows, shed the pending queue

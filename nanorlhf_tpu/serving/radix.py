@@ -378,6 +378,69 @@ class RadixCache:
                 leaf.page_map[idx] = pid
             self.stats["inserted_nodes"] += 1
 
+    def extend_text(self, key: tuple) -> None:
+        """Insert `key` into the tree as TEXT ONLY — no pages adopted
+        (empty `page_map`). The decode session calls this at row release
+        with the row's prompt key extended by its GENERATED tokens
+        (mask bit 1), so the tree remembers what followed each cached
+        prefix even though the generated tokens' KV pages were recycled.
+        `matched_continuation` reads these nodes to seed the n-gram
+        drafter (sampler/speculative.py). Text-only nodes are safe by
+        construction elsewhere: `plan()` degrades a match to the covered
+        page prefix when it walks past the paged region (the existing
+        coverage-gap rule), and `_evict_one` collapses empty-page_map
+        leaves instead of counting them as pool exhaustion."""
+        assert self.pool is not None
+        with self._lock:
+            self._clock += 1
+            node, pos = self._root, 0
+            while pos < len(key):
+                node.last_use = self._clock
+                child = node.children.get(key[pos])
+                if child is None:
+                    break
+                common = 0
+                limit = min(len(child.edge), len(key) - pos)
+                while common < limit and \
+                        child.edge[common] == key[pos + common]:
+                    common += 1
+                if common < len(child.edge):
+                    self._split(child, common)
+                    child = node.children[key[pos]]
+                node, pos = child, pos + common
+            if pos >= len(key):
+                node.last_use = self._clock
+                return                       # full key already cached
+            leaf = _Node(key[pos:], len(key), node)
+            node.children[key[pos]] = leaf
+            leaf.last_use = self._clock
+            self.stats["inserted_nodes"] += 1
+
+    def matched_continuation(self, key: tuple, window: int) -> np.ndarray:
+        """Up to `window` DECODE-TOKEN ids cached past `key`'s longest
+        tree match — what some earlier request's text continued with
+        after this prompt's matched prefix (descending the most recently
+        used child at each branch). Elements with the mask bit unset
+        (pad-layout keys) are dropped, so the result is plain token ids
+        ready for the drafter's seed buffer. Empty when the key is cold."""
+        with self._lock:
+            m, node, _pages = self._match(key)
+            if m == 0:
+                return np.zeros((0,), np.int32)
+            cont: list = []
+            # tail of the edge the match ended inside (m == node.end
+            # means the edge is fully consumed and we descend directly)
+            edge_off = m - (node.end - len(node.edge))
+            cur = node
+            while len(cont) < window:
+                cont.extend(cur.edge[edge_off:])
+                edge_off = 0
+                if not cur.children:
+                    break
+                cur = max(cur.children.values(), key=lambda n: n.last_use)
+            toks = [k // 2 for k in cont if k & 1]
+            return np.asarray(toks[:window], np.int32)
+
     def _split(self, child: _Node, at: int) -> None:
         """Split `child`'s edge `at` elements in: a new mid node takes
         the pages whose coverage ends at or before the split point."""

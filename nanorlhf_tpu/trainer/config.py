@@ -326,10 +326,24 @@ class RLConfig:
     # refcount-shared KV pages with zero prefill FLOPs and only the
     # suffix is prefilled. Greedy streams stay bit-identical to the
     # uncached path (test-pinned); sampled streams are equal in
-    # distribution only. Incompatible with rollout_spec_k > 0. Default
-    # off: the cache resets every generate call (KV is params-tied), so
-    # it only pays when rollout prompts overlap.
+    # distribution only. COMPOSES with rollout_spec_k > 0 — the n-gram
+    # drafter seeds its lookup window from the cached continuation of
+    # the matched prefix, so overlapping prompts accept drafts from the
+    # first generated token (sampler.compose_check holds the full
+    # legality matrix). Default off: the cache resets every generate
+    # call (KV is params-tied), so it only pays when rollout prompts
+    # overlap.
     rollout_prefix_cache: bool = False
+    # continuous batching only. >0: any admission whose real prompt
+    # suffix exceeds this many tokens is split into KV-only chunk
+    # forwards interleaved with the resident rows' decode chunks
+    # (sampler/paged/session.py) — a long cold prompt no longer stalls
+    # every live stream for its whole prefill, bounding the p95
+    # inter-token gap. Greedy streams are bit-identical to 0 (the final
+    # chunk samples from the same admission PRNG fold, test-pinned);
+    # sampled streams are equal in distribution only (a delayed row
+    # decodes at later global PRNG folds). 0 = whole-suffix admissions.
+    rollout_prefill_chunk: int = 0
 
     # ---- environments (envs/, docs/ENVIRONMENTS.md) ----
     # "" = no environment (the classic reward_func pipeline, unchanged).

@@ -74,7 +74,7 @@ from nanorlhf_tpu.ops.masking import (
 )
 from nanorlhf_tpu.parallel.mesh import (MeshConfig, batch_sharding, make_mesh,
                                         shard_params)
-from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.sampler import SamplingParams, compose_check, generate
 from nanorlhf_tpu.telemetry import (DEFAULT_RULES, HealthConfig,
                                     HealthMonitor, LatencyHub,
                                     LineageLedger, SLO_RULES, SpanTracer,
@@ -609,20 +609,21 @@ class RLTrainer:
         # through it — one long-lived object so the cumulative stats feed
         # pages/shared + /statusz "prefix_cache"; the scheduler resets its
         # pool/tree every generate call (cached KV is params-tied).
+        # decode-feature legality is validated ONCE here through the same
+        # compose_check generate() re-runs per call — the trainer fails at
+        # construction, not mid-run, and the matrix lives in one place
+        # (sampler/sampler.py). spec×prefix now COMPOSES (the session
+        # seeds the drafter from the radix continuation).
+        compose_check(
+            SamplingParams(
+                compaction_segments=config.rollout_compaction_segments,
+                page_size=config.rollout_page_size,
+                decode_rows=config.rollout_decode_rows,
+                spec_k=config.rollout_spec_k,
+                prefill_chunk=config.rollout_prefill_chunk),
+            prefix_cache=config.rollout_prefix_cache)
         self.prefix_cache = None
         if config.rollout_prefix_cache:
-            if config.rollout_spec_k > 0:
-                raise ValueError(
-                    "rollout_prefix_cache is incompatible with "
-                    "rollout_spec_k > 0 (the radix admission path does "
-                    "not model the speculative carry) — pick one")
-            if not (config.rollout_page_size > 0
-                    and config.rollout_decode_rows > 0):
-                raise ValueError(
-                    "rollout_prefix_cache requires continuous batching: "
-                    "set rollout_page_size > 0 and rollout_decode_rows "
-                    "> 0 (the monolithic paths have no admission point "
-                    "to cache across)")
             from nanorlhf_tpu.serving.radix import RadixCache
             self.prefix_cache = RadixCache()
         # environments (envs/, docs/ENVIRONMENTS.md): env_name builds an
@@ -1016,6 +1017,18 @@ class RLTrainer:
             out["rollout/prefix_hit_frac"] = float(
                 paged_stats["prefix_hit_frac"])
             out["pages/shared"] = float(paged_stats["shared_pages"])
+        if "dispatch_events" in paged_stats:
+            # decode-session accounting (continuous batching only,
+            # sampler/paged/session.py): total device dispatches =
+            # admission launches + decode/verify chunk iterations — the
+            # number the spec×prefix composition gate drives down — plus
+            # the chunked-prefill admission counters
+            out["session/dispatch_events"] = float(
+                paged_stats["dispatch_events"])
+            out["session/chunked_admissions"] = float(
+                paged_stats["chunked_admissions"])
+            out["session/prefill_backlog"] = float(
+                paged_stats["prefill_backlog_peak"])
         return out
 
     # ------------------------------------------------------------------ #
@@ -1122,6 +1135,11 @@ class RLTrainer:
             # (serving/radix.py snapshot); None when the lever is off
             "prefix_cache": (self.prefix_cache.snapshot()
                              if self.prefix_cache is not None else None),
+            # decode session (continuous batching): end-of-rollout snapshot
+            # — resident rows + per-row feature flags, chunked-prefill
+            # backlog, dispatch counters (sampler/paged/session.py
+            # status()); None until a queued rollout has run
+            "session": getattr(self, "_session_status", None),
         }
         if orch is not None and hasattr(orch, "status_snapshot"):
             out.update(orch.status_snapshot())
@@ -1630,6 +1648,7 @@ class RLTrainer:
             spec_k=cfg.rollout_spec_k, spec_ngram=cfg.rollout_spec_ngram,
             page_size=cfg.rollout_page_size,
             decode_rows=cfg.rollout_decode_rows,
+            prefill_chunk=cfg.rollout_prefill_chunk,
         )
         if self._env_multi_turn:
             # per-TURN generation budget: the episode driver packs model
@@ -1907,6 +1926,10 @@ class RLTrainer:
                     rows=pstats["rows"], num_pages=pstats["num_pages"],
                     page_size=pstats["page_size"],
                 )
+                # the continuous-batching scheduler also ships its decode
+                # session's end-of-call status for /statusz "session";
+                # the monolithic paged paths have no session
+                self._session_status = pstats.get("session")
                 if self.lineage.enabled:
                     for adm in pstats.get("admissions") or []:
                         self.lineage.event(

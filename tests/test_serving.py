@@ -315,15 +315,34 @@ def test_greedy_bit_parity_and_fewer_prefill_dispatch(tiny):
     assert "prefix_hit_frac" not in off       # radix-only stat keys
 
 
-def test_prefix_cache_spec_k_incompatible(tiny):
+def test_prefix_cache_spec_k_composes(tiny):
+    """spec decode UNDER the radix prefix cache (the decode-session
+    composition that used to raise): greedy output is bit-identical to
+    the radix-alone run, and the session stats carry both features'
+    counters. The deeper A/B gates (fewer dispatch events than either
+    feature alone) live in tests/test_session.py."""
     config, params = tiny
     ids, mask = _left_pad(OVERLAP_PROMPTS[:4], 12)
-    sp = SamplingParams(max_tokens=4, greedy=True, page_size=4,
-                        decode_rows=2, spec_k=2)
-    with pytest.raises(ValueError, match="prefix_cache"):
-        generate(params, config, ids, mask, jax.random.PRNGKey(0), sp,
-                 eos_token_id=EOS, pad_token_id=PAD,
-                 prefix_cache=RadixCache())
+    base = dict(max_tokens=4, greedy=True, page_size=4, decode_rows=2)
+    stats_r, stats_rs, spec_stats = [], [], []
+    out_r = generate(params, config, ids, mask, jax.random.PRNGKey(0),
+                     SamplingParams(**base), eos_token_id=EOS,
+                     pad_token_id=PAD, paged_stats_out=stats_r,
+                     prefix_cache=RadixCache())
+    out_rs = generate(params, config, ids, mask, jax.random.PRNGKey(0),
+                      SamplingParams(**base, spec_k=2),
+                      eos_token_id=EOS, pad_token_id=PAD,
+                      paged_stats_out=stats_rs,
+                      spec_stats_out=spec_stats,
+                      prefix_cache=RadixCache())
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_rs))
+    entry = stats_rs[0]
+    assert entry["prefix_hit_tokens"] > 0          # radix did its job
+    assert spec_stats and int(np.asarray(
+        spec_stats[0]["drafted"])) >= 0            # spec carry ran
+    feats = entry["session"]["features"]
+    assert feats["spec_k"] == 2 and feats["prefix_cache"]
+    assert feats["drafter_seed_window"] > 0        # satellite (b): seeded
 
 
 # --------------------------------------------------------------------- #
@@ -443,14 +462,19 @@ def test_trainer_knob_validation(tmp_path):
     # default off
     from nanorlhf_tpu.trainer.config import RLConfig
     assert RLConfig().rollout_prefix_cache is False
-    # requires continuous batching
+    # requires continuous batching (compose_check, the one legality matrix)
     with pytest.raises(ValueError, match="continuous batching"):
         make_trainer(AlgoName.GRPO, tmp_path, rollout_prefix_cache=True)
-    # incompatible with speculative decode
-    with pytest.raises(ValueError, match="rollout_spec_k"):
-        make_trainer(AlgoName.GRPO, tmp_path / "b",
-                     rollout_prefix_cache=True, rollout_page_size=4,
-                     rollout_decode_rows=2, rollout_spec_k=2)
+    # spec decode now COMPOSES with the prefix cache (decode session):
+    # the trainer constructs cleanly where it used to raise
+    tr = make_trainer(AlgoName.GRPO, tmp_path / "b",
+                      rollout_prefix_cache=True, rollout_page_size=4,
+                      rollout_decode_rows=2, rollout_spec_k=2)
+    assert tr.prefix_cache is not None
+    # chunked prefill also rides continuous batching only
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        make_trainer(AlgoName.GRPO, tmp_path / "c",
+                     rollout_prefill_chunk=4)
 
 
 def test_grpo_update_with_prefix_cache(tmp_path):
@@ -548,8 +572,9 @@ def test_engine_cancel_active_releases_pages(tiny):
         assert (radix["free_pages"] + radix["cached_pages"]
                 == snap["num_pages"])
         assert radix["shared_pages"] == 0
-        # the device block table holds no live rows either
-        assert int((np.asarray(eng._table) < eng.num_pages).sum()) == 0
+        # the session's block table holds no live rows either
+        assert int((np.asarray(eng.session.table_np)
+                    < eng.num_pages).sum()) == 0
 
         eng.cancel(req)                # idempotent: reaped requests no-op
         assert eng.snapshot()["counters"]["cancelled"] == c["cancelled"]

@@ -1,0 +1,332 @@
+"""Decode session: feature composition on one scheduler loop (ISSUE 18).
+
+The session refactor's acceptance contract, pinned:
+
+  * COMPOSITION PARITY — greedy queued output is bit-identical across
+    every legal feature combination: plain, radix prefix cache, spec
+    decode, spec UNDER radix, chunked prefill on/off (× radix). The
+    existing per-feature parity suites (test_paged_cache, test_serving,
+    test_speculative, test_envs) now run THROUGH the session — `generate`
+    has no non-session queued path — so this file pins only the
+    combinations that used to be illegal.
+  * DISPATCH A/B — on an overlapping corpus, spec+radix combined issues
+    STRICTLY fewer dispatch events (admission launches + decode/verify
+    chunk iterations) than either feature alone, and strictly fewer
+    prefill tokens than spec alone. Events, not tokens, is the honest
+    combined-vs-radix metric: a verify step dispatches k+1 tokens where
+    plain decode dispatches 1, trading tokens-per-launch for fewer
+    launches (docs/DECODE_ANALYSIS.md §dispatch accounting).
+  * DRAFTER SEEDING — satellite (b): admissions seed the n-gram drafter
+    from the radix tree's cached continuation of the matched prefix
+    (radix.extend_text / matched_continuation), so repeat prompts accept
+    drafts from the first generated token instead of cold-starting.
+  * ONE CODE PATH — serving/engine.py owns no decode loop: its chunk fn
+    IS the session's, and a gateway-shaped per-row stream equals the
+    rollout scheduler's greedy stream for the same prompt.
+  * compose_check — the single legality matrix: what still raises, and
+    that everything else constructs.
+
+CI runs this file as the `session-parity` tier-1 step under
+NANORLHF_LOCK_CHECK=1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.core import ModelConfig, init_params
+from nanorlhf_tpu.sampler import SamplingParams, compose_check, generate
+from nanorlhf_tpu.serving.radix import RadixCache, prompt_key
+
+EOS, PAD = 3, 0
+TP = 12          # padded prompt width
+MT = 8           # max_tokens
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = ModelConfig.qwen2_tiny(vocab_size=128)
+    params = init_params(config, jax.random.PRNGKey(7), jnp.float32)
+    return config, params
+
+
+def _left_pad(rows, T, pad=PAD):
+    ids = np.full((len(rows), T), pad, np.int32)
+    for i, r in enumerate(rows):
+        ids[i, T - len(r):] = r
+    ids = jnp.asarray(ids)
+    return ids, ids != pad
+
+
+# one 8-real-token family repeated: maximal prefix overlap, so radix
+# full-hits every repeat and (after the first release extends the tree
+# with the generated text) the drafter seed covers the whole greedy
+# continuation of rows 3..6
+FAMILY = [5, 6, 7, 8, 9, 10, 11, 12]
+OVERLAP = [FAMILY] * 6
+
+
+def _run(tiny, *, spec_k=0, radix=False, prefill_chunk=0, greedy=True,
+         prompts=OVERLAP, key=0):
+    config, params = tiny
+    ids, mask = _left_pad(prompts, TP)
+    sp = SamplingParams(max_tokens=MT, greedy=greedy, page_size=4,
+                        decode_rows=2, spec_k=spec_k,
+                        prefill_chunk=prefill_chunk,
+                        temperature=1.0, top_p=0.9)
+    stats, spec_stats = [], []
+    out = generate(params, config, ids, mask, jax.random.PRNGKey(key),
+                   sp, eos_token_id=EOS, pad_token_id=PAD,
+                   paged_stats_out=stats, spec_stats_out=spec_stats,
+                   prefix_cache=RadixCache() if radix else None)
+    return np.asarray(out), stats[0], (spec_stats[0] if spec_stats
+                                       else None)
+
+
+@pytest.fixture(scope="module")
+def ab(tiny):
+    """The four corners of the spec×radix square, one call each."""
+    runs = {}
+    runs["plain"] = _run(tiny)
+    runs["radix"] = _run(tiny, radix=True)
+    runs["spec"] = _run(tiny, spec_k=3)
+    runs["both"] = _run(tiny, spec_k=3, radix=True)
+    return runs
+
+
+def test_spec_under_radix_three_way_bit_parity(ab):
+    """Greedy output identical across plain / radix / spec / spec+radix:
+    the composition that raised ValueError before the session exists and
+    changes dispatch shape ONLY."""
+    ref = ab["plain"][0]
+    for name in ("radix", "spec", "both"):
+        np.testing.assert_array_equal(
+            ref, ab[name][0], err_msg=f"{name} diverged from plain")
+
+
+def test_combined_strictly_fewer_dispatch_events(ab):
+    """THE perf gate: spec+radix < min(each alone) in dispatch EVENTS on
+    the overlapping corpus — the radix hit removes prefill iterations
+    and the SEEDED drafter removes decode iterations that unseeded spec
+    cannot (the continuation lives in the tree, not in the repeat row's
+    own prompt). Also: combined moves strictly fewer prefill tokens than
+    spec alone (the radix half of the win, token-denominated)."""
+    ev = {k: v[1]["dispatch_events"] for k, v in ab.items()}
+    assert ev["both"] < min(ev["radix"], ev["spec"]), ev
+    assert (ab["both"][1]["prefill_token_dispatch"]
+            < ab["spec"][1]["prefill_token_dispatch"])
+    # the mechanism, not just the outcome: the seed window is armed and
+    # seeded acceptance strictly beats unseeded on this corpus
+    feats = ab["both"][1]["session"]["features"]
+    assert feats["spec_k"] == 3 and feats["prefix_cache"]
+    assert feats["drafter_seed_window"] > 0
+    acc_both = int(np.asarray(ab["both"][2]["accepted"]))
+    acc_spec = int(np.asarray(ab["spec"][2]["accepted"]))
+    assert acc_both > acc_spec, (acc_both, acc_spec)
+
+
+@pytest.mark.parametrize("radix", [False, True],
+                         ids=["cold-pool", "radix"])
+def test_chunked_prefill_bit_identical(tiny, radix):
+    """prefill_chunk on/off: greedy streams bit-identical (the final
+    chunk runs the same bucketed suffix forward and samples from the
+    same admission fold), with the chunked run actually chunking —
+    backlog observed, admissions split."""
+    out0, st0, _ = _run(tiny, radix=radix)
+    out1, st1, _ = _run(tiny, radix=radix, prefill_chunk=4)
+    np.testing.assert_array_equal(out0, out1)
+    assert st0["chunked_admissions"] == 0
+    assert st1["chunked_admissions"] > 0
+    assert st1["prefill_backlog_peak"] > 0
+    # chunking must not change WHAT ran, only when: same decode output,
+    # same rows admitted
+    assert st1["admitted_midloop"] >= st0["admitted_midloop"]
+
+
+def test_session_stats_surface(ab):
+    """The /statusz `session` section the trainer re-exports: mode,
+    per-row flags, counters — shaped for tools/inspect_run.py."""
+    s = ab["both"][1]["session"]
+    assert s["mode"] == "rollout"
+    assert s["rows"] == 2 and len(s["row_flags"]) == 2
+    assert s["counters"]["dispatch_events"] == (
+        s["counters"]["launches"] + s["counters"]["decode_iterations"])
+    assert s["pending_prefill"] == {"rows": [], "backlog_tokens": 0}
+
+
+# --------------------------------------------------------------------- #
+# drafter seeding primitives (satellite b)
+# --------------------------------------------------------------------- #
+
+def test_radix_text_extension_and_continuation():
+    rc = RadixCache()
+    rc.reset(num_pages=16, page_size=4)
+    toks = np.asarray(FAMILY, np.int32)
+    row = np.full(TP, PAD, np.int32)
+    row[TP - len(toks):] = toks
+    mask = row != PAD
+    key = prompt_key(row, mask)
+    plan = rc.plan(key, pad_count=TP - len(toks), n_blocks=5,
+                   prompt_len=TP)
+    rc.insert(key, plan.row_pages, TP)
+    # nothing generated yet: the continuation of the full prompt is empty
+    assert rc.matched_continuation(key, 8).size == 0
+    gen = [40, 41, 42, 43]
+    rc.extend_text(key + tuple(t * 2 + 1 for t in gen))
+    np.testing.assert_array_equal(rc.matched_continuation(key, 8), gen)
+    # window truncates from the front of the continuation
+    np.testing.assert_array_equal(rc.matched_continuation(key, 2),
+                                  gen[:2])
+    # an unknown prompt has no continuation
+    other = prompt_key(np.roll(row, 1), mask)
+    assert rc.matched_continuation(other, 8).size == 0
+    # text-only leaves hold no pages: releasing the one holder frees the
+    # whole pool (the extension can never leak a page)
+    rc.release(plan.row_pages.copy())
+    rc.reset(num_pages=16, page_size=4)
+    assert rc.pool.free_count == 16
+
+
+# --------------------------------------------------------------------- #
+# one scheduler code path: serving == rollout (tentpole composition 3)
+# --------------------------------------------------------------------- #
+
+def test_engine_has_no_private_decode_loop():
+    import nanorlhf_tpu.sampler.paged.scheduler as sched
+    import nanorlhf_tpu.sampler.paged.session as session
+    import nanorlhf_tpu.serving.engine as engine
+
+    # the engine's pre-session loop primitives are GONE, not just unused
+    for name in ("_engine_chunk", "_engine_decode_body", "_engine_install",
+                 "_ENGINE_STATIC"):
+        assert not hasattr(engine, name), name
+    # the rollout scheduler drives the session's chunk fns, not copies
+    assert sched._decode_chunk is session._decode_chunk
+    assert sched._spec_chunk is session._spec_chunk
+    assert sched.DecodeSession is session.DecodeSession
+
+
+def test_gateway_stream_equals_rollout_stream(tiny):
+    """Same prompt, same greedy params: the engine's per-request stream
+    and the rollout scheduler's row are the same token sequence — the
+    pin that serving and rollout share one scheduler code path."""
+    from nanorlhf_tpu.sampler.paged.session import DecodeSession
+    from nanorlhf_tpu.serving.engine import ServingEngine
+
+    config, params = tiny
+    rollout, _, _ = _run(tiny, prompts=[FAMILY])
+    row = rollout[0]
+    eos = np.nonzero(row == EOS)[0]
+    want = row[:int(eos[0]) + 1] if eos.size else row
+
+    eng = ServingEngine(params, config, eos_token_id=EOS,
+                        pad_token_id=PAD, page_size=4, prompt_len=TP,
+                        max_new_tokens=MT, rows=2, seed=0)
+    try:
+        assert isinstance(eng.session, DecodeSession)
+        req, reason = eng.submit(FAMILY, greedy=True)
+        assert reason is None
+        got = np.asarray(list(eng.stream(req)), np.int32)
+        snap = eng.snapshot()
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(got, want)
+    sess = snap["session"]
+    assert sess["mode"] == "serving"
+    assert sess["features"]["per_row_sampling"]
+    assert len(sess["row_flags"]) == eng.rows
+
+
+def test_engine_chunked_prefill_stream_identical(tiny):
+    """Engine with prefill_chunk: the long cold prompt's stream is
+    bit-identical to the unchunked engine (first token rides _deliver
+    instead of the admission return), and the session counted the
+    chunked admission."""
+    from nanorlhf_tpu.serving.engine import ServingEngine
+
+    config, params = tiny
+
+    def serve(prefill_chunk):
+        eng = ServingEngine(params, config, eos_token_id=EOS,
+                            pad_token_id=PAD, page_size=4, prompt_len=TP,
+                            max_new_tokens=MT, rows=2, seed=0,
+                            prefill_chunk=prefill_chunk)
+        try:
+            req, reason = eng.submit(FAMILY, greedy=True)
+            assert reason is None
+            toks = list(eng.stream(req))
+            snap = eng.snapshot()
+        finally:
+            eng.close()
+        return toks, snap
+
+    t0, s0 = serve(0)
+    t1, s1 = serve(4)
+    assert t0 == t1
+    assert s0["session"]["counters"]["chunked_admissions"] == 0
+    assert s1["session"]["counters"]["chunked_admissions"] == 1
+    assert s1["counters"]["completed"] == 1
+
+
+def test_engine_spec_greedy_stream_identical(tiny):
+    """Engine with spec_k: greedy streams match the non-spec engine
+    bit-for-bit (verify accepts the argmax chain), and non-greedy /
+    short-budget submits are rejected up front — the verify rule
+    compiles against static sampling params."""
+    from nanorlhf_tpu.serving.engine import ServingEngine
+
+    config, params = tiny
+
+    def serve(spec_k):
+        eng = ServingEngine(params, config, eos_token_id=EOS,
+                            pad_token_id=PAD, page_size=4, prompt_len=TP,
+                            max_new_tokens=MT, rows=2, seed=0,
+                            spec_k=spec_k)
+        try:
+            if spec_k:
+                with pytest.raises(ValueError, match="greedy"):
+                    eng.submit(FAMILY, greedy=False)
+                with pytest.raises(ValueError, match="greedy"):
+                    eng.submit(FAMILY, greedy=True, max_tokens=2)
+            req, reason = eng.submit(FAMILY, greedy=True)
+            assert reason is None
+            return list(eng.stream(req))
+        finally:
+            eng.close()
+
+    assert serve(0) == serve(3)
+
+
+# --------------------------------------------------------------------- #
+# compose_check: the one legality matrix
+# --------------------------------------------------------------------- #
+
+ILLEGAL = [
+    (dict(page_size=4, compaction_segments=2), False, "page_size"),
+    (dict(spec_k=2, compaction_segments=2), False, "spec_k"),
+    (dict(), True, "continuous batching"),
+    (dict(page_size=4), True, "continuous batching"),
+    (dict(prefill_chunk=4), False, "prefill_chunk"),
+    (dict(page_size=4, prefill_chunk=4), False, "prefill_chunk"),
+]
+
+LEGAL = [
+    dict(page_size=4, decode_rows=2, spec_k=3),
+    dict(page_size=4, decode_rows=2, prefill_chunk=4, spec_k=3),
+    dict(page_size=4, spec_k=3),
+    dict(compaction_segments=2),
+]
+
+
+@pytest.mark.parametrize("kw,pc,match", ILLEGAL)
+def test_compose_check_illegal(kw, pc, match):
+    with pytest.raises(ValueError, match=match):
+        compose_check(SamplingParams(**kw), prefix_cache=pc)
+
+
+@pytest.mark.parametrize("kw", LEGAL)
+def test_compose_check_legal(kw):
+    compose_check(SamplingParams(**kw), prefix_cache=(
+        kw.get("page_size", 0) > 0 and kw.get("decode_rows", 0) > 0))

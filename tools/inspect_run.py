@@ -298,9 +298,12 @@ def serving_report(path: str) -> dict:
         snap = json.load(f)
     if isinstance(snap.get("counters"), dict):         # gateway /statusz
         cache = snap.get("prefix_cache")
-        engine = {k: v for k, v in snap.items() if k != "prefix_cache"}
-        return {"engine": engine, "prefix_cache": cache}
-    return {"engine": None, "prefix_cache": snap.get("prefix_cache")}
+        engine = {k: v for k, v in snap.items()
+                  if k not in ("prefix_cache", "session")}
+        return {"engine": engine, "prefix_cache": cache,
+                "session": snap.get("session")}
+    return {"engine": None, "prefix_cache": snap.get("prefix_cache"),
+            "session": snap.get("session")}
 
 
 def _print_serving(rep: dict) -> None:
@@ -320,6 +323,28 @@ def _print_serving(rep: dict) -> None:
                   f"p{int(100 * slo.get('quantile', 0.95))} "
                   f"> {slo.get('warn_s')}s after "
                   f"{slo.get('warmup')} samples")
+    sess = rep.get("session")
+    if sess is not None:
+        # the decode session (sampler/paged/session.py status()): resident
+        # rows + per-row feature flags, the chunked-prefill backlog, and
+        # the dispatch counters the spec×prefix A/B gates read
+        print("decode session:")
+        print(f"  {'mode':<24s} {sess.get('mode')}")
+        print(f"  {'rows':<24s} {sess.get('live_rows')}/{sess.get('rows')}"
+              " live")
+        feats = sess.get("features") or {}
+        on = [k if v is True else f"{k}={v}"
+              for k, v in sorted(feats.items()) if v]
+        print(f"  {'features':<24s} {', '.join(on) if on else '(none)'}")
+        pend = sess.get("pending_prefill") or {}
+        print(f"  {'prefill backlog':<24s} rows={pend.get('rows')} "
+              f"tokens={pend.get('backlog_tokens')}")
+        for k, v in sorted((sess.get("counters") or {}).items()):
+            print(f"  counters.{k:<24s} {v}")
+        for i, rf in enumerate(sess.get("row_flags") or []):
+            flags = [k if v is True else f"{k}={v}"
+                     for k, v in sorted(rf.items()) if v]
+            print(f"  row[{i}]: {', '.join(flags) if flags else 'idle'}")
     cache = rep["prefix_cache"]
     if cache is None:
         print("prefix cache: (absent — rollout_prefix_cache off, or "
