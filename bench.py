@@ -69,6 +69,14 @@ bit-identical 4-way and combined prefill tokens below spec-alone's,
 plus the chunked-prefill p95 inter-token-gap gate at <= 1.2x the
 no-long-prompt baseline on a live engine stream,
 docs/PAGED_CACHE.md §session),
+BENCH_SWAP (1: also run the in-flight weight-swap A/B and report
+detail.swap — in-flight mid-sequence swaps vs drain-and-wait at the SAME
+mid-decode publish offset (one staleness bound, met two ways), reporting
+generator idle fraction, swap installs, and episodes/s; acceptance
+in-flight idle strictly below drain-and-wait's with >= 1 install and
+segments stamped on the live rows, plus swap_overhead_frac < 1% for an
+armed-but-silent refresh vs weight_refresh=None, greedy bit-identical
+throughout, docs/ORCHESTRATOR.md §in-flight swaps),
 BENCH_ENV (1: also run the multi-turn environment A/B and report
 detail.env — 2-turn python-tool episodes vs the single-turn degenerate
 case at EQUAL resident batch, reporting turns/episode and the tool-stall
@@ -649,6 +657,162 @@ def _paged_check(jax) -> dict:
         "paged_check": "ok" if (
             identical and queued_dispatches < fixed_dispatches
             and sec_q < sec_f
+        ) else "MISMATCH",
+    }
+
+
+def _swap_check(jax) -> dict:
+    """In-flight mid-sequence weight swaps vs drain-and-wait A/B
+    (ISSUE 20, docs/ORCHESTRATOR.md §in-flight swaps) on a deterministic
+    chain machine, queued paged scheduler on both sides. A publisher
+    thread publishes a fresh (numerically identical, so outputs stay
+    comparable) weight version at the SAME wall-clock offset in both
+    modes — one staleness bound, met two ways: drain-and-wait finishes
+    its in-flight half, sits IDLE until the publish lands, then runs the
+    second half on the new version; in-flight queues everything at once
+    and installs the publish at a host-sync chunk boundary mid-stream.
+    Reports generator idle fraction (drain: measured publish wait;
+    in-flight: the cumulative install stall `swap_wait_s`), swap
+    installs, and episodes/s — the ISSUE-20 gate is strictly lower idle
+    in-flight. Plus the no-publish overhead gate: an armed-but-silent
+    refresh callback (store never republishes) must cost < 1% wall vs
+    `weight_refresh=None` (`swap_overhead_frac`). Runs on every backend
+    (tiny model); gate with BENCH_SWAP=0."""
+    import dataclasses
+    import threading
+
+    import jax.numpy as jnp
+
+    from nanorlhf_tpu.core import ModelConfig, init_params
+    from nanorlhf_tpu.orchestrator.weight_store import (
+        VersionedWeightStore, make_swap_refresh, store_poll)
+    from nanorlhf_tpu.sampler import SamplingParams, generate
+
+    V, R, resp, P = 64, 4, 40, 4
+    EOS, PAD = 3, 0
+    # same compute-dominant sizing rationale as _paged_check: the swap
+    # poll trades a lock+compare per chunk, measurable only when chunk
+    # compute dominates the jit-call floor
+    mcfg = dataclasses.replace(
+        ModelConfig.qwen2_tiny(vocab_size=V), tie_word_embeddings=False,
+        hidden_size=256, intermediate_size=512, num_hidden_layers=4,
+    )
+    params = init_params(mcfg, jax.random.PRNGKey(0), jnp.float32)
+    D = mcfg.hidden_size
+    layers = jax.tree.map(jnp.zeros_like, params["layers"])
+    for ln in ("input_layernorm", "post_attention_layernorm"):
+        layers[ln] = jnp.ones_like(layers[ln])
+    params["layers"] = layers
+    params["embed_tokens"] = jnp.zeros((V, D), jnp.float32).at[
+        jnp.arange(V), jnp.arange(V)
+    ].set(1.0)
+    sigma = np.arange(V)
+    for t in range(10, 50):                             # chains -> EOS
+        sigma[t] = t + 1
+    sigma[50] = EOS
+    params["lm_head"] = jnp.zeros((D, V), jnp.float32).at[
+        jnp.arange(V), jnp.asarray(sigma)
+    ].set(12.0 / np.sqrt(D))
+
+    # start v emits min(50 - v + 1, resp) tokens; two interleaved halves
+    # with matched length mixes, so drain's first half costs ~half the
+    # full-queue wall
+    starts = [11, 16, 21, 26, 31, 36, 41, 46,
+              13, 18, 23, 28, 33, 38, 43, 48]
+    Q = len(starts) // 2
+    prompts = np.full((len(starts), 5), PAD, np.int32)
+    prompts[:, 3] = 9                                   # inert filler state
+    prompts[:, 4] = starts
+    ids, mask = jnp.asarray(prompts), jnp.asarray(prompts != PAD)
+    sp = SamplingParams(greedy=True, max_tokens=resp, page_size=P,
+                        decode_rows=R)
+    kw = dict(eos_token_id=EOS, pad_token_id=PAD)
+
+    def run(ids_, mask_, refresh=None, stats=None):
+        return np.asarray(generate(
+            params, mcfg, ids_, mask_, jax.random.PRNGKey(0), sp,
+            paged_stats_out=stats, weight_refresh=refresh, **kw))
+
+    run(ids, mask)                                      # compile: full queue
+    run(ids[:Q], mask[:Q])                              # compile: half queue
+    sec_plain = float("inf")
+    for _ in range(2):
+        t0 = time.time()
+        ref_out = run(ids, mask)
+        sec_plain = min(sec_plain, time.time() - t0)
+
+    # ---- no-publish overhead: armed-but-silent refresh vs None --------
+    store = VersionedWeightStore()
+    store.publish(params)                               # v0, never again
+    sec_armed = float("inf")
+    for _ in range(2):
+        st: list = []
+        t0 = time.time()
+        out_silent = run(ids, mask, stats=st,
+                         refresh=make_swap_refresh(store_poll(store),
+                                                   have_version=0))
+        sec_armed = min(sec_armed, time.time() - t0)
+    silent_identical = bool(np.array_equal(out_silent, ref_out))
+    silent_installs = int(st[-1]["swap_installs"])
+    overhead = max(0.0, (sec_armed - sec_plain) / sec_plain)
+
+    t_pub = 0.75 * sec_plain                            # mid-decode publish
+
+    # ---- in-flight: one queue, install at a chunk boundary ------------
+    store = VersionedWeightStore()
+    store.publish(params)
+    timer = threading.Timer(t_pub, lambda: store.publish(params))
+    st = []
+    t0 = time.time()
+    timer.start()
+    out_if = run(ids, mask, stats=st,
+                 refresh=make_swap_refresh(store_poll(store),
+                                           have_version=0))
+    wall_if = time.time() - t0
+    timer.cancel()
+    installs = int(st[-1]["swap_installs"])
+    idle_if = float(st[-1]["swap_wait_s"])
+    segments = st[-1]["segments"]
+
+    # ---- drain-and-wait: half, idle until the publish, half -----------
+    store = VersionedWeightStore()
+    store.publish(params)
+    poll = store_poll(store)
+    timer = threading.Timer(t_pub, lambda: store.publish(params))
+    t0 = time.time()
+    timer.start()
+    out_a = run(ids[:Q], mask[:Q])
+    t_idle0 = time.time()
+    while poll(0)[1] is None:                           # the drained idle
+        time.sleep(0.001)
+    idle_dw = time.time() - t_idle0
+    out_b = run(ids[Q:], mask[Q:])
+    wall_dw = time.time() - t0
+    timer.cancel()
+    out_dw = np.concatenate([out_a, out_b])
+
+    identical = bool(np.array_equal(out_if, ref_out)
+                     and np.array_equal(out_dw, ref_out))
+    return {
+        "queue_length": len(starts),
+        "decode_rows": R,
+        "response_length": resp,
+        "publish_at_s": round(t_pub, 3),
+        "swap_installs": installs,
+        "rows_multi_segment": sum(1 for s in segments if len(s) > 1),
+        "idle_frac_inflight": round(idle_if / wall_if, 4),
+        "idle_frac_drain": round(idle_dw / wall_dw, 4),
+        "episodes_per_sec_inflight": round(len(starts) / wall_if, 2),
+        "episodes_per_sec_drain": round(len(starts) / wall_dw, 2),
+        "sec_inflight": round(wall_if, 3),
+        "sec_drain": round(wall_dw, 3),
+        "swap_overhead_frac": round(overhead, 4),
+        "silent_poll_installs": silent_installs,
+        "greedy_bit_identical": identical,
+        "swap_check": "ok" if (
+            identical and silent_identical and silent_installs == 0
+            and installs >= 1 and idle_if < idle_dw
+            and overhead < 0.01
         ) else "MISMATCH",
     }
 
@@ -1830,6 +1994,17 @@ def run_bench(jax, init_error):
             traffic_detail = _traffic_check(jax)
         except Exception as e:
             traffic_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
+    swap_detail = None
+    if os.environ.get("BENCH_SWAP", "1") == "1":
+        try:
+            # in-flight weight-swap A/B (tiny model, any backend) — the
+            # ISSUE-20 gates: in-flight installs a mid-decode publish at a
+            # chunk boundary with strictly lower generator idle than
+            # drain-and-wait at the same publish offset, and an armed-but-
+            # silent refresh costs < 1% wall vs weight_refresh=None
+            swap_detail = _swap_check(jax)
+        except Exception as e:
+            swap_detail = {"error": f"{type(e).__name__}: {e}"[:300]}
     env_detail = None
     if os.environ.get("BENCH_ENV", "1") == "1":
         try:
@@ -1864,6 +2039,7 @@ def run_bench(jax, init_error):
         **({"serving": serving_detail} if serving_detail is not None else {}),
         **({"session": session_detail} if session_detail is not None else {}),
         **({"traffic": traffic_detail} if traffic_detail is not None else {}),
+        **({"swap": swap_detail} if swap_detail is not None else {}),
         **({"env": env_detail} if env_detail is not None else {}),
         "prompts_per_update": episodes_per_update,
         "sample_n": sample_n,

@@ -42,13 +42,24 @@ _F_SITE, _F_AT, _F_EVERY, _F_COUNT, _F_WORKER, _F_DELAY = range(6)
 # is a bounded perturbation the resilience stack recovers from without
 # exhausting a budget (crash→lease reassignment, slow→straggler
 # redispatch, save/produce/reward→retry paths).
+#
+# ORDER MATTERS: site selection is a keyed shuffle over this tuple, so
+# reordering or inserting entries reshuffles every composed plan. The
+# current order keeps the pinned seed-3 plan (test_seed3_plans_are_
+# pinned) drawing {worker.crash, worker.slow, ckpt.save} — the
+# deterministic crash-recovery soak — while swap.stale stays reachable
+# under other seeds. swap.stale only fires on a run with
+# rollout_inflight_swaps enabled (a mid-rollout install stalls briefly,
+# then installs anyway), so a soak that composes it without swaps
+# enabled records zero fires for that clause.
 TRAINER_SITES = (
     "ckpt.save",
     "rollout.produce",
     "reward.exec",
     "worker.slow",
-    "worker.crash",
+    "swap.stale",
     "worker.fetch_weights",
+    "worker.crash",
 )
 
 # loadgen→engine serving path: the only wired serving-side site today
@@ -142,6 +153,13 @@ def _clause(site: str, key: int, n_workers: int) -> str:
     if site == "worker.fetch_weights":
         return (f"worker.fetch_weights:at="
                 f"{randint(fold_in(key, _F_AT), 1, 3)},worker=0")
+    if site == "swap.stale":
+        # small stall before a mid-rollout install (the default delay
+        # action installs the tree anyway — recoverable by construction)
+        every = randint(fold_in(key, _F_EVERY), 1, 4)
+        delay = round(0.02 + 0.06 * uniform(fold_in(key, _F_DELAY)), 3)
+        count = randint(fold_in(key, _F_COUNT), 1, 3)
+        return f"swap.stale:every={every},delay={delay},count={count}"
     if site == "gw.disconnect":
         every = randint(fold_in(key, _F_EVERY), 2, 6)
         count = randint(fold_in(key, _F_COUNT), 1, 4)

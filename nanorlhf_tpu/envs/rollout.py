@@ -52,6 +52,7 @@ from nanorlhf_tpu.core.model import init_paged_kv_cache
 from nanorlhf_tpu.envs.base import Environment
 from nanorlhf_tpu.sampler import generate
 from nanorlhf_tpu.sampler.paged.pages import blocks_per_row, init_page_state
+from nanorlhf_tpu.sampler.paged.scheduler import _finalize_segments
 from nanorlhf_tpu.sampler.paged.session import (
     _ADMIT_BASE,
     _admit_one,
@@ -95,6 +96,7 @@ def run_env_episodes(
     sync_every: int = 8,
     faults=None,
     tool_threads: int = 4,
+    weight_refresh=None,
 ) -> dict:
     """Run one vectorized batch of multi-turn episodes; returns a payload:
 
@@ -107,6 +109,18 @@ def run_env_episodes(
       obs_range, reward, tok_range)
     - ``stats``       the ``env/*`` metric rows (docs/METRICS.md)
     - ``pages_recycled``/``admissions`` — continuation-loop paged evidence
+
+    ``weight_refresh`` (optional ``() -> (version, tree|None)``): in-flight
+    mid-sequence weight swaps (docs/ORCHESTRATOR.md §in-flight swaps). The
+    callback is polled once per main-loop iteration — the driver's host
+    sync point, which also covers every multi-turn re-admission — and a
+    newer tree replaces ``params`` for all subsequent prefills and decode
+    chunks. The payload then ALSO carries ``segments`` (per-episode
+    ``[{policy_version, tok_range}]`` in packed response-token coordinates,
+    the same space as ``turns``' tok_range), ``swap_installs`` and
+    ``swap_wait_s``. With no mid-rollout publish the poll returns
+    ``(version, None)`` every time and the episode streams are bit-identical
+    to ``weight_refresh=None``.
     """
     if sampling.max_tokens != turn_tokens:
         raise ValueError(
@@ -121,6 +135,23 @@ def run_env_episodes(
     n = sampling.n
     rows_total = B * n
     P = int(page_size)
+
+    # ---- in-flight weight swaps (docs/ORCHESTRATOR.md §in-flight swaps) -
+    swaps = weight_refresh is not None
+    swap_installs = 0
+    swap_wait_s = 0.0
+    cur_version = None
+    seg_bounds: list[list] = [[] for _ in range(rows_total)]
+    if swaps:
+        # base install: the serial refresh's first call (have_version=None)
+        # returns the store's latest outright — installed before turn 1 and
+        # NOT counted as a swap
+        t0_sw = time.perf_counter()
+        cur_version, fresh = weight_refresh()
+        swap_wait_s += time.perf_counter() - t0_sw
+        if fresh is not None:
+            params = fresh
+        seg_bounds = [[(cur_version, 0)] for _ in range(rows_total)]
 
     # ---- turn 1: the existing pipeline, bit-for-bit --------------------
     first = generate(
@@ -268,6 +299,31 @@ def run_env_episodes(
     while completed < rows_total:
         for fut in [f for f in list(futures) if f.done()]:
             harvest(fut)
+        if swaps:
+            # host sync point: one non-blocking poll per loop iteration —
+            # BEFORE admissions, so a re-admitted turn prefills under the
+            # freshly installed params and its tokens sit past the boundary
+            t0_sw = time.perf_counter()
+            version, fresh = weight_refresh()
+            if fresh is not None:
+                # swap boundary in packed response coordinates: committed
+                # span tokens + the live row's generated-so-far count. The
+                # EOS trim at finish_turn can only shorten a live span, so
+                # finalize clamps bounds monotonically into [0, total].
+                n_gen_h = (np.asarray(carry[7])
+                           if carry is not None else None)
+                committed = [sum(int(t.size) for _, t in spans[ep])
+                             for ep in range(rows_total)]
+                if n_gen_h is not None:
+                    for r in range(R):
+                        if owner[r] >= 0:
+                            committed[owner[r]] += int(n_gen_h[r])
+                for ep in range(rows_total):
+                    seg_bounds[ep].append((version, committed[ep]))
+                params = fresh
+                cur_version = version
+                swap_installs += 1
+            swap_wait_s += time.perf_counter() - t0_sw
         while pending and any(o < 0 for o in owner):
             r = next(i for i, o in enumerate(owner) if o < 0)
             ep, ids, mask = pending.popleft()
@@ -326,6 +382,7 @@ def run_env_episodes(
     loss_mask = np.ones((rows_total, response_length), bool)
     turn_ends = np.full((rows_total, max_turns), -1, np.int64)
     turns_records: list[dict] = []
+    totals = [0] * rows_total
     for ep in range(rows_total):
         cur, t_idx = 0, 0
         rec_by_turn: list[dict] = []
@@ -350,6 +407,22 @@ def run_env_episodes(
                 rec_by_turn[-1]["obs_tokens"] = L
             cur += L
         turns_records.extend(rec_by_turn)
+        totals[ep] = cur
+
+    segments_out = None
+    if swaps:
+        segments_out = []
+        for ep in range(rows_total):
+            total = totals[ep]
+            bounds, hi = [], 0
+            for v, pos in seg_bounds[ep]:
+                # running max + clip: the EOS trim and the response_length
+                # clip can only shorten spans, so bounds stay a monotone
+                # tiling of [0, total]; empty trailing segments (a swap
+                # after this episode finished) are dropped by finalize
+                hi = max(hi, min(int(pos), total))
+                bounds.append((v, hi))
+            segments_out.append(_finalize_segments(bounds, total))
 
     turns_count = np.asarray(cur_turn, np.float32)
     stats = {
@@ -361,7 +434,7 @@ def run_env_episodes(
             overlap_chunks / decode_chunks if decode_chunks else 0.0),
         "env/tool_errors": float(tool_errors),
     }
-    return {
+    payload = {
         "tokens": out,
         "loss_mask": loss_mask,
         "scores": turn_rewards.sum(axis=1).astype(np.float32),
@@ -372,3 +445,10 @@ def run_env_episodes(
         "pages_recycled": pages_recycled,
         "admissions": admissions,
     }
+    if swaps:
+        # conditional keys (the loss_mask pattern): present only when the
+        # in-flight swap path is live, so swaps-off payloads are unchanged
+        payload["segments"] = segments_out
+        payload["swap_installs"] = swap_installs
+        payload["swap_wait_s"] = round(swap_wait_s, 6)
+    return payload

@@ -77,9 +77,12 @@ from nanorlhf_tpu.orchestrator.sample_queue import (
     ProducerFailed,
     QueuedSample,
 )
-from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
+from nanorlhf_tpu.orchestrator.weight_store import (
+    VersionedWeightStore,
+    make_swap_refresh,
+)
 from nanorlhf_tpu.resilience.retry import backoff_delay
-from nanorlhf_tpu.telemetry.lineage import spec_summary
+from nanorlhf_tpu.telemetry.lineage import segments_summary, spec_summary
 
 
 class FleetExhausted(ProducerFailed):
@@ -836,12 +839,27 @@ class FleetTransport:
         """-> (version, param_tree) of the newest published policy."""
         raise NotImplementedError
 
+    def poll_weights(self, worker_id: int, have_version: int, stop=None):
+        """Non-blocking in-flight swap check (docs/ORCHESTRATOR.md
+        §in-flight swaps): -> (version, tree|None), tree None when nothing
+        newer than `have_version` is published. Unlike `fetch_weights`
+        this NEVER waits and never fires the worker.fetch_weights fault —
+        it runs inside the decode loop's host sync window, where a stall
+        is generator idle time. Base implementation: swaps unsupported,
+        always (have_version, None)."""
+        return have_version, None
+
     def heartbeat(self, worker_id: int) -> None:
         raise NotImplementedError
 
-    def dispatch(self, worker_id: int, index: int, queries, tree):
+    def dispatch(self, worker_id: int, index: int, queries, tree,
+                 weight_refresh=None):
         """Run generation for rollout `index`; returns a DEVICE-READY
-        payload (the transport owns the block_until_ready)."""
+        payload (the transport owns the block_until_ready).
+        `weight_refresh` (optional `() -> (version, tree|None)`) is the
+        in-flight swap callback threaded down to the decode driver; the
+        transport forwards it to the dispatch closure only when set, so
+        4-arg dispatch_fn signatures keep working with swaps off."""
         raise NotImplementedError
 
 
@@ -868,11 +886,27 @@ class InProcessTransport(FleetTransport):
             0, timeout=self._weight_timeout, stop=stop
         )
 
+    def poll_weights(self, worker_id: int, have_version: int, stop=None):
+        # direct non-blocking store read; deliberately NOT the
+        # worker.fetch_weights fault site (that models the per-lease
+        # blocking fetch) — the in-flight path has its own swap.stale site
+        # fired by make_swap_refresh at install time
+        v = self._store.version
+        if v < 0 or v <= have_version:
+            return max(v, have_version), None
+        return self._store.latest()
+
     def heartbeat(self, worker_id: int) -> None:
         self._coord.heartbeat(worker_id)
 
-    def dispatch(self, worker_id: int, index: int, queries, tree):
-        payload = self._dispatch_fn(index, queries, tree, worker_id)
+    def dispatch(self, worker_id: int, index: int, queries, tree,
+                 weight_refresh=None):
+        if weight_refresh is not None:
+            payload = self._dispatch_fn(
+                index, queries, tree, worker_id, weight_refresh
+            )
+        else:
+            payload = self._dispatch_fn(index, queries, tree, worker_id)
         import jax  # lazy: keeps fleet.py importable jax-free for units
 
         jax.block_until_ready(payload)
@@ -884,7 +918,8 @@ class RolloutWorker:
 
     def __init__(self, worker_id: int, coordinator: FleetCoordinator,
                  transport: FleetTransport, meter=None, faults=None,
-                 tracer=None, lineage=None, latency=None):
+                 tracer=None, lineage=None, latency=None,
+                 inflight_swaps: bool = False):
         self.worker_id = worker_id
         self._coord = coordinator
         self._transport = transport
@@ -892,6 +927,12 @@ class RolloutWorker:
         self._faults = faults
         self._tracer = tracer
         self._lineage = lineage
+        # in-flight mid-sequence weight swaps (docs/ORCHESTRATOR.md
+        # §in-flight swaps): each dispatch gets a refresh callback that
+        # polls the transport for newer weights at the decode loop's host
+        # sync points, seeded with the dispatch version so the first poll
+        # is a no-op unless a publish landed after fetch_weights
+        self._inflight_swaps = bool(inflight_swaps)
         # telemetry.LatencyHub: dispatch→device-ready per generation —
         # the fleet's generation-wall + TTFT-upper-bound sketches. All
         # workers share ONE hub: its histograms are mergeable, but
@@ -981,10 +1022,20 @@ class RolloutWorker:
                 # windows and the queue's transit stamps. (Cross-host
                 # transports must measure latency on ONE host's clock —
                 # these stamps are taken coordinator-side, so that holds.)
+                refresh = None
+                if self._inflight_swaps:
+                    refresh = make_swap_refresh(
+                        lambda have: self._transport.poll_weights(
+                            self.worker_id, have, stop=self._stop
+                        ),
+                        have_version=version, faults=self._faults,
+                        worker=self.worker_id,
+                    )
                 t0 = time.perf_counter()
                 with span:
                     payload = self._transport.dispatch(
-                        self.worker_id, index, lease.batches[offset], tree
+                        self.worker_id, index, lease.batches[offset], tree,
+                        weight_refresh=refresh,
                     )
                 t1 = time.perf_counter()
                 if self._meter is not None:
@@ -1001,6 +1052,8 @@ class RolloutWorker:
                         index, policy_version=version,
                         worker_id=self.worker_id, lease_id=lease.lease_id,
                         gen_s=round(t1 - t0, 6), spec=spec_summary(payload),
+                        segments=segments_summary(payload),
+                        swap_wait_s=payload.get("swap_wait_s"),
                     )
                 self._coord.complete(
                     self.worker_id, lease, index,
@@ -1052,6 +1105,15 @@ class FleetOrchestrator:
     fault matrix and bit-parity tests cover the network code on CPU CI).
     `rpc` (an orchestrator.rpc.RpcConfig) carries address/timeout/retry
     knobs; None = loopback on an ephemeral port.
+
+    `inflight_swaps=True` hands every dispatch an in-flight weight-swap
+    refresh callback (weight_store.make_swap_refresh over the transport's
+    non-blocking `poll_weights`): the decode driver installs mid-rollout
+    publishes at its host sync points and the payload/ledger carry
+    per-segment {policy_version, tok_range} provenance
+    (docs/ORCHESTRATOR.md §in-flight swaps). With no mid-rollout publish
+    the callback returns (version, None) every poll and the token stream
+    is bit-identical to swaps off — test-pinned over both transports.
     """
 
     def __init__(
@@ -1073,6 +1135,7 @@ class FleetOrchestrator:
         transport: str = "inprocess",
         rpc=None,
         latency=None,
+        inflight_swaps: bool = False,
     ):
         if n_workers < 1:
             raise ValueError(f"n_workers={n_workers} must be >= 1")
@@ -1091,6 +1154,7 @@ class FleetOrchestrator:
         self._tracer = tracer
         self._lineage = lineage
         self._latency = latency
+        self._inflight_swaps = bool(inflight_swaps)
         self.coordinator = FleetCoordinator(
             queue=self.queue, batch_fn=batch_fn, start_index=start_index,
             config=fleet, faults=faults, tracer=tracer, meter=self.meter,
@@ -1163,7 +1227,7 @@ class FleetOrchestrator:
         w = RolloutWorker(
             wid, coord, transport, meter=self.meter,
             faults=self._faults, tracer=self._tracer, lineage=self._lineage,
-            latency=self._latency,
+            latency=self._latency, inflight_swaps=self._inflight_swaps,
         )
         # register BEFORE start: the worker's first acquire must find its
         # membership record (alive() treats not-yet-started as alive)

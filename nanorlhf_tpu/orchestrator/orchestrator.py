@@ -36,7 +36,10 @@ from nanorlhf_tpu.orchestrator.sample_queue import (
     QueuedSample,
 )
 from nanorlhf_tpu.orchestrator.weight_store import VersionedWeightStore
-from nanorlhf_tpu.telemetry.lineage import spec_summary as _spec_summary
+from nanorlhf_tpu.telemetry.lineage import (
+    segments_summary as _segments_summary,
+    spec_summary as _spec_summary,
+)
 
 
 def _merge_intervals(ivs: list[tuple[float, float]]) -> list[tuple[float, float]]:
@@ -319,6 +322,8 @@ class RolloutOrchestrator:
                         idx, policy_version=version, worker_id=0,
                         gen_s=round(t1 - t0, 6),
                         spec=_spec_summary(payload),
+                        segments=_segments_summary(payload),
+                        swap_wait_s=payload.get("swap_wait_s"),
                     )
                 self.queue.put(QueuedSample(idx, version, payload, t0, t1))
                 if tr is not None and tr.enabled:

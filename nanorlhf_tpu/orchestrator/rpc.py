@@ -1038,6 +1038,21 @@ class RpcTransport(FleetTransport):
     def fetch_weights(self, worker_id: int, stop=None):
         return self._client.fetch_weights(stop=stop)
 
+    def poll_weights(self, worker_id: int, have_version: int, stop=None):
+        # in-flight swap poll (docs/ORCHESTRATOR.md §in-flight swaps): the
+        # client's by-version cache makes the no-newer-weights case one tiny
+        # have_version round trip (the server answers "unchanged" and no
+        # leaf bytes move). Transport failures are swallowed — a missed
+        # poll is a missed swap opportunity inside the decode loop, not a
+        # worker failure; the next sync point retries.
+        try:
+            version, tree = self._client.fetch_weights(stop=stop)
+        except (TransportError, RemoteCallError):
+            return have_version, None
+        if version <= have_version:
+            return version, None
+        return version, tree
+
     def heartbeat(self, worker_id: int) -> None:
         # best-effort: a missed heartbeat is COUNTED, never fatal — the
         # coordinator notices real silence through the lease deadline
@@ -1047,8 +1062,14 @@ class RpcTransport(FleetTransport):
         except (TransportError, RemoteCallError):
             self._client.heartbeat_misses += 1
 
-    def dispatch(self, worker_id: int, index: int, queries, tree):
-        payload = self._dispatch_fn(index, queries, tree, worker_id)
+    def dispatch(self, worker_id: int, index: int, queries, tree,
+                 weight_refresh=None):
+        if weight_refresh is not None:
+            payload = self._dispatch_fn(
+                index, queries, tree, worker_id, weight_refresh
+            )
+        else:
+            payload = self._dispatch_fn(index, queries, tree, worker_id)
         import jax  # lazy: keeps rpc.py importable jax-free for units
 
         jax.block_until_ready(payload)

@@ -22,9 +22,64 @@ the store is plain host-side bookkeeping (no jax import).
 from __future__ import annotations
 
 import threading
+import time
 
 from nanorlhf_tpu.analysis.lockorder import make_condition
-from typing import Any, Optional
+from typing import Any, Callable, Optional
+
+
+def store_poll(store: "VersionedWeightStore") -> Callable:
+    """Non-blocking `poll(have) -> (version, tree|None)` reading `store`
+    directly — the serial/in-process side of `make_swap_refresh`. Never
+    waits: an unpublished store (version < 0) reports `(have, None)`."""
+    def poll(have: int):
+        v = store.version
+        if v < 0 or v <= have:
+            return max(v, have), None
+        return store.latest()
+    return poll
+
+
+def make_swap_refresh(poll: Callable, *, have_version: Optional[int] = None,
+                      faults=None, worker: Optional[int] = None) -> Callable:
+    """Build the in-flight weight-swap callback (docs/ORCHESTRATOR.md
+    §in-flight swaps) handed down to the decode drivers.
+
+    `poll(have) -> (version, tree|None)` is the transport-specific
+    non-blocking check (`store_poll` for direct store readers, the fleet
+    transports' `poll_weights` otherwise — the RPC client's version cache
+    makes an unchanged-policy poll one tiny have_version round trip).
+
+    The returned `refresh() -> (version, tree|None)` is what the queued
+    scheduler / env episode driver calls at each host sync point: `tree`
+    is None when the held version is still the newest (install nothing),
+    otherwise the fresh param tree to install before the next decode
+    chunk. `have_version=None` (the serial path, where the dispatch
+    closure does not know which version it was handed) makes the FIRST
+    call return the store's latest outright — the caller installs it
+    pre-loop without counting a swap.
+
+    When a newer tree is about to be handed over, the `swap.stale` fault
+    site fires (docs/RESILIENCE.md): the default `delay` action sleeps
+    first and installs anyway — deliberately landing a version that may
+    already be superseded; the next sync point then installs the newer
+    one, so the ledger's per-segment versions stay strictly increasing.
+    """
+    state = {"v": have_version}
+
+    def refresh():
+        have = state["v"]
+        version, tree = poll(-1 if have is None else have)
+        if tree is None or (have is not None and version <= have):
+            return (version if have is None else max(version, have)), None
+        if faults is not None:
+            act = faults.fire("swap.stale", worker=worker)
+            if act and str(act).startswith("delay:"):
+                time.sleep(float(str(act).split(":", 1)[1]))
+        state["v"] = version
+        return version, tree
+
+    return refresh
 
 
 class VersionedWeightStore:

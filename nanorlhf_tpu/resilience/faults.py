@@ -75,6 +75,18 @@ Storage-integrity points (wired in trainer/checkpoint.py):
                    back to the newest EARLIER intact checkpoint instead of
                    failing the run, counting `resilience/ckpt_fallbacks`
 
+In-flight weight-swap point (wired in orchestrator/weight_store.py's
+make_swap_refresh, the poll callback the decode driver calls at its host
+sync points — `worker=I` selects the polling worker):
+
+    swap.stale     the swap install stalls `delay` seconds before the
+                   fresh tree is handed over (default action "delay") —
+                   long enough, the next publish lands during the stall
+                   and the tree being installed is already superseded; the
+                   NEXT sync point's poll installs the newer one, so the
+                   versions recorded in the segment ledger stay strictly
+                   increasing
+
 Spec grammar (config `fault_spec` or env `NANORLHF_FAULT`; entries separated
 by ";" or whitespace):
 
@@ -153,6 +165,12 @@ INJECTION_POINTS = frozenset({
     # storage-integrity site (trainer/checkpoint.py): the restored
     # checkpoint reads back corrupt/torn
     "ckpt.corrupt",
+    # in-flight weight-swap site (orchestrator/weight_store.py
+    # make_swap_refresh): the mid-rollout install stalls past the next
+    # publish — the stalled tree lands already superseded and the next
+    # sync point installs the newer one (ledger versions stay strictly
+    # increasing)
+    "swap.stale",
 })
 
 ACTIONS = ("raise", "nan", "hang", "delay",
@@ -171,6 +189,7 @@ _DEFAULT_ACTIONS = {
     "env.hang": "delay",
     "gw.disconnect": "drop",
     "ckpt.corrupt": "tear",
+    "swap.stale": "delay",
 }
 
 

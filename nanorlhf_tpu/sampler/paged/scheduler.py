@@ -65,6 +65,8 @@ the previous owner never leak through the masked attention.
 
 from __future__ import annotations
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -84,6 +86,28 @@ from nanorlhf_tpu.sampler.paged.session import (  # noqa: F401
     _spec_chunk,
     DecodeSession,
 )
+
+
+def _finalize_segments(bounds: list, total: int) -> list:
+    """Close one request's `{policy_version, tok_range}` list.
+
+    `bounds` is the chronological [(version, start_tok), ...] recorded at
+    admission and at each swap; each segment ends where the next begins,
+    the last at `total` generated tokens. Empty spans (a swap landing
+    before the row's first token, or after it finished) are dropped, so
+    the survivors exactly tile [0, total) with strictly increasing
+    versions."""
+    segs = [
+        {"policy_version": bounds[i][0],
+         "tok_range": [bounds[i][1],
+                       bounds[i + 1][1] if i + 1 < len(bounds) else total]}
+        for i in range(len(bounds))
+        if (bounds[i + 1][1] if i + 1 < len(bounds) else total) > bounds[i][1]
+    ]
+    if not segs:
+        segs = [{"policy_version": bounds[-1][0] if bounds else None,
+                 "tok_range": [0, total]}]
+    return segs
 
 
 def generate_tokens_queued(
@@ -113,6 +137,7 @@ def generate_tokens_queued(
     paged_stats_out: list | None = None,
     latency=None,
     prefix_cache=None,
+    weight_refresh=None,
 ):
     """Host-driven continuous-batching generation: `generate_tokens`
     contract over the whole queue ([Q, max_tokens] int32 in queue order, or
@@ -145,7 +170,22 @@ def generate_tokens_queued(
     `prefill_chunk > 0` splits every per-row admission whose real suffix
     exceeds the chunk width into KV-only forwards, one per sync chunk —
     greedy/sampled streams are bit-identical to `prefill_chunk=0` (the
-    final chunk samples from the same admission fold)."""
+    final chunk samples from the same admission fold).
+
+    `weight_refresh` (optional `() -> (version, tree|None)`, built by
+    `orchestrator.weight_store.make_swap_refresh`): in-flight mid-sequence
+    weight swaps (docs/ORCHESTRATOR.md §in-flight swaps). Polled once
+    pre-loop (a returned tree is the BASE install — not counted as a swap)
+    and once per host sync chunk; a newer tree is installed as
+    `sess.params` before the next decode chunk — params is a traced
+    argument of the jitted chunk fns, so the install never recompiles —
+    and every live row gets a segment boundary at its current generated
+    length. The paged-stats entry then carries `segments` (queue-order
+    per-request `{policy_version, tok_range}` lists that exactly tile
+    `[0, n_generated)` with strictly increasing versions),
+    `swap_installs`, and `swap_wait_s`. With no mid-rollout publish the
+    poll returns None every chunk and the token stream is bit-identical
+    to `weight_refresh=None` (the PRNG stream never sees the callback)."""
     Q, Tp = prompt_ids.shape
     R = min(int(decode_rows), Q)
     P = int(page_size)
@@ -183,17 +223,38 @@ def generate_tokens_queued(
     util_samples: list[float] = []
     shared_peak = 0
 
+    # in-flight weight swaps: per-queue-index (version, start_tok) bounds
+    swaps = weight_refresh is not None
+    cur_version = None
+    swap_installs = 0
+    swap_wait_s = 0.0
+    seg_bounds: dict[int, list] = {}
+    seg_final: dict[int, list] = {}
+    if swaps:
+        t0 = time.perf_counter()
+        cur_version, fresh = weight_refresh()
+        if fresh is not None:
+            # base install: a publish raced the dispatch — start the whole
+            # stream on the newer tree (single segment, newer version)
+            sess.params = fresh
+            swap_wait_s += time.perf_counter() - t0
+
     if radix is not None:
         # initial batch admits row-by-row through the radix path (the
         # same path mid-loop admissions use)
         for r in range(R):
             sess.admit(r, prompt_np[next_q], pmask_np[next_q], next_q)
             owner[r] = next_q
+            if swaps:
+                seg_bounds[next_q] = [(cur_version, 0)]
             next_q += 1
     else:
         sess.bootstrap(prompt_ids, prompt_mask)
         owner = list(range(R))
         next_q = R
+        if swaps:
+            for q in range(R):
+                seg_bounds[q] = [(cur_version, 0)]
 
     while True:
         done_h, installed = sess.step()
@@ -209,6 +270,10 @@ def generate_tokens_queued(
         pending = sess.pending_rows()
         finished = [r for r in range(R)
                     if done_h[r] and owner[r] >= 0 and r not in pending]
+        if swaps and finished and not spec:
+            # generated-length sync only when a row actually flushes — the
+            # no-publish steady state stays free of extra device syncs
+            n_gen_h = np.asarray(sess.state[7])
         for r in finished:
             q = owner[r]
             out_all[q] = np.asarray(sess.state[1][r])
@@ -218,6 +283,9 @@ def generate_tokens_queued(
             if spec:
                 acc_all[q] = int(row_acc_h[r])
                 gen = out_all[q][:int(n_gen_h[r])]
+            if swaps:
+                seg_final[q] = _finalize_segments(
+                    seg_bounds.pop(q), int(n_gen_h[r]))
             owner[r] = -1
             # radix: drop the REQUEST's refs; pages the tree still holds
             # survive as cached prefix KV (and, with spec, the generated
@@ -230,9 +298,30 @@ def generate_tokens_queued(
             next_q += 1
             sess.admit(r, prompt_np[q], pmask_np[q], q)
             owner[r] = q
+            if swaps:
+                seg_bounds[q] = [(cur_version, 0)]
             if not sess.is_pending(r):
                 admissions.append({"row": r, "queue_index": q,
                                    "iteration": it_now})
+        if swaps:
+            # THE host sync point (ISSUE 20): poll the store once per
+            # chunk; a newer tree is installed before the next decode
+            # chunk and every live row's segment list gets a boundary at
+            # its current generated length
+            t0 = time.perf_counter()
+            version, fresh = weight_refresh()
+            if fresh is not None:
+                # post-churn snapshot: rows admitted THIS sync read 0 here,
+                # so their boundary collapses to a dropped empty segment
+                n_gen_now = np.asarray(sess.state[7])
+                for r in range(R):
+                    if owner[r] >= 0:
+                        seg_bounds[owner[r]].append(
+                            (version, int(n_gen_now[r])))
+                sess.params = fresh
+                cur_version = version
+                swap_installs += 1
+                swap_wait_s += time.perf_counter() - t0
         # pool occupancy AFTER this sync's churn: allocated / total pages
         util_samples.append(sess.utilization())
         shared_peak = max(shared_peak, sess.shared_pages())
@@ -259,6 +348,12 @@ def generate_tokens_queued(
             # feature flags, pending-prefill backlog, dispatch counters)
             "session": sess.status(),
         }
+        if swaps:
+            entry.update({
+                "segments": [seg_final[q] for q in range(Q)],
+                "swap_installs": swap_installs,
+                "swap_wait_s": swap_wait_s,
+            })
         if radix is not None:
             lookup_tok = radix.stats["lookup_tokens"] - stats0["lookup_tokens"]
             entry.update({

@@ -590,6 +590,7 @@ def generate(
     paged_stats_out: list | None = None,
     latency=None,
     prefix_cache=None,
+    weight_refresh=None,
 ) -> jnp.ndarray:
     """vllm_generate-contract entry: [B*N, max_tokens], N consecutive per
     prompt; (tokens, logprobs) when `sampling.capture_logprobs`.
@@ -626,7 +627,16 @@ def generate(
     from the n>1 fanout and repeated dataset prompts. Ignored by the
     non-queued paths; COMPOSES with spec_k > 0 (the drafter seeds its
     lookup window from the cached continuation — see compose_check for
-    the full legality matrix)."""
+    the full legality matrix).
+
+    `weight_refresh` (optional `() -> (version, tree|None)`): in-flight
+    mid-sequence weight swaps on the QUEUED paged path only — polled at
+    every host sync chunk, a newer tree replaces the session params before
+    the next decode chunk and the paged-stats entry grows per-request
+    `segments` (docs/ORCHESTRATOR.md §in-flight swaps). The monolithic
+    one-jit paths have no host sync point to swap at and ignore it (the
+    trainer's `rollout_inflight_swaps` validation requires the queued
+    path)."""
     compose_check(sampling, prefix_cache=(
         prefix_cache is not None
         and getattr(prefix_cache, "enabled", False)))
@@ -659,6 +669,7 @@ def generate(
             prefill_chunk=sampling.prefill_chunk,
             spec_stats_out=spec_stats_out, paged_stats_out=paged_stats_out,
             latency=latency, prefix_cache=prefix_cache,
+            weight_refresh=weight_refresh,
         )
     if sampling.spec_k > 0:
         from nanorlhf_tpu.sampler.speculative import generate_spec

@@ -15,9 +15,11 @@ passes through:
                two events for one index carry both worker ids), PRNG
                fold-in key path
     generation policy version, wall time, spec-decode per-row accepted
-               tokens / draft acceptance, and the `segments` schema hook
-               ([{policy_version, tok_range}]) that ROADMAP item 2's
-               mid-sequence weight swaps will populate with >1 entry
+               tokens / draft acceptance, and the `segments` list
+               ([{row, policy_version, tok_range}]) that in-flight
+               mid-sequence weight swaps populate with one entry per
+               policy-version span (docs/ORCHESTRATOR.md §in-flight swaps;
+               `tools/inspect_run.py --segments` is the query side)
     queue      enqueue/dequeue monotonic times, staleness at consumption
     reward     per-sample score, retry attempt, grader wall time
     outcome    advantage, kept rows; excluded rows land as `drop` events
@@ -196,9 +198,9 @@ class LineageLedger:
     def generation(self, rollout_index: int, *, policy_version=None,
                    worker_id=None, lease_id=None, gen_s=None, spec=None,
                    segments=None, **fields) -> int:
-        # `segments` defaults to the single-policy whole-range entry; a
-        # mid-sequence weight swap (ROADMAP item 2) appends one entry per
-        # swapped segment with its tok_range
+        # `segments` defaults to the single-policy whole-range entry; the
+        # in-flight weight-swap path passes `segments_summary(payload)` —
+        # one entry per {policy_version, tok_range} span per row
         if segments is None and policy_version is not None:
             segments = [{"policy_version": policy_version,
                          "tok_range": [0, None]}]
@@ -369,6 +371,32 @@ class LineageLedger:
                     pass
                 self._fh = None
         self.enabled = False
+
+
+def segments_summary(payload) -> Optional[list]:
+    """Flatten a rollout payload's per-row `segments` lists (stamped by the
+    in-flight weight-swap path, docs/ORCHESTRATOR.md §in-flight swaps) into
+    the flat JSON list generation events carry:
+
+        [{"row": r, "policy_version": v, "tok_range": [start, end]}, ...]
+
+    `tok_range` is in response-token coordinates — the same space as `turn`
+    events' tok_range, so swap boundaries and turn boundaries join directly.
+    None when the payload carries no segments (swaps off, or a non-dict
+    payload): `LineageLedger.generation` then falls back to the
+    single-policy whole-range default."""
+    segs = payload.get("segments") if isinstance(payload, dict) else None
+    if not segs:
+        return None
+    out = []
+    for row, row_segs in enumerate(segs):
+        for s in row_segs or ():
+            out.append({
+                "row": row,
+                "policy_version": _jsonable(s.get("policy_version")),
+                "tok_range": _jsonable(s.get("tok_range")),
+            })
+    return out or None
 
 
 def spec_summary(payload) -> Optional[dict]:

@@ -169,6 +169,21 @@ class RLConfig:
     # variance at a small bias toward under-weighting fresh-policy-favored
     # tokens.
     offpolicy_is_truncation: float = 2.0
+    # ---- in-flight mid-sequence weight swaps (docs/ORCHESTRATOR.md
+    # §in-flight swaps). PipelineRL-style: instead of draining in-flight
+    # generations at a publish (idle rollout silicon) or letting them run
+    # whole-sequence stale (every token behind the policy), the decode
+    # drivers poll the weight store at their host sync points and install a
+    # newer snapshot MID-SEQUENCE; the ledger stamps per-generation
+    # `segments` ([{policy_version, tok_range}]) and the loss applies
+    # PER-SEGMENT truncated-IS weights (algos/losses.segment_is_weights:
+    # older segments get a tighter clamp, ρ̄^(1/(1+age))). Requires
+    # rollout_orchestrator with a host-sync rollout path — the queued paged
+    # scheduler (rollout_page_size>0 and rollout_decode_rows>0) or the
+    # multi-turn env driver; the monolithic one-jit sampler has no swap
+    # point. Off (or at max_staleness=0, where no publish can land
+    # mid-rollout): bit-identical to main, test-pinned.
+    rollout_inflight_swaps: bool = False
     # ---- elastic rollout fleet (orchestrator/fleet.py, docs/FLEET.md).
     # >1 generalizes the orchestrator's single producer thread into N
     # independent, preemptible rollout workers behind a FleetCoordinator:

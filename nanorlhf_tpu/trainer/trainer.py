@@ -414,12 +414,30 @@ class RLTrainer:
         # only the sampler capture provides them; without capture the PPO
         # ratio clip alone absorbs the staleness drift (rollout_ahead's
         # documented behavior)
+        if config.rollout_inflight_swaps:
+            if not config.rollout_orchestrator:
+                raise ValueError(
+                    "rollout_inflight_swaps reads the orchestrator's weight "
+                    "store mid-generation — it requires "
+                    "rollout_orchestrator=True (docs/ORCHESTRATOR.md)"
+                )
+            if config.rollout_page_size <= 0 or config.rollout_decode_rows <= 0:
+                raise ValueError(
+                    "rollout_inflight_swaps swaps weights at chunk boundaries "
+                    "of the queued paged scheduler — it requires "
+                    "rollout_page_size > 0 and rollout_decode_rows > 0 "
+                    "(docs/PAGED_CACHE.md)"
+                )
         self._use_is = (
             config.rollout_orchestrator
             and config.max_staleness > 0
             and config.sampler_logprob_capture
             and config.offpolicy_correction == "truncated_is"
         )
+        # per-segment IS (docs/ORCHESTRATOR.md §in-flight swaps): only
+        # meaningful when generations can span >1 policy version; without
+        # swaps every row is single-segment and whole-sequence IS is exact
+        self._use_seg = self._use_is and config.rollout_inflight_swaps
         self._orchestrator = None
         self._orch_restore_state = None  # journal from a resumed checkpoint
         from nanorlhf_tpu.orchestrator import OverlapMeter
@@ -867,17 +885,20 @@ class RLTrainer:
                     return np.asarray(next(self._iter))
 
                 def fleet_dispatch(index: int, queries, tree: dict,
-                                   worker_id: int) -> dict:
+                                   worker_id: int,
+                                   weight_refresh=None) -> dict:
                     # the same stateless index-keyed PRNG stream as every
                     # other mode: WHICH worker generates a sample can never
-                    # change WHAT is generated (staleness-0 bit parity)
+                    # change WHAT is generated (staleness-0 bit parity).
+                    # `weight_refresh` arrives only when the transport saw
+                    # inflight_swaps=True (4-arg calls stay valid).
                     key = jax.random.fold_in(self._rollout_base, index)
                     gen_mesh = None
                     if self.worker_meshes:
                         gen_mesh = self.worker_meshes[
                             worker_id % len(self.worker_meshes)
                         ]
-                    return body(queries, key, tree, gen_mesh)
+                    return body(queries, key, tree, gen_mesh, weight_refresh)
 
                 self._orchestrator = FleetOrchestrator(
                     dispatch_fn=fleet_dispatch,
@@ -907,6 +928,7 @@ class RLTrainer:
                     ),
                     transport=cfg.rollout_transport,
                     rpc=rpc_cfg,
+                    inflight_swaps=cfg.rollout_inflight_swaps,
                 )
             else:
                 from nanorlhf_tpu.orchestrator import RolloutOrchestrator
@@ -919,7 +941,22 @@ class RLTrainer:
                     # fast-forwards reproduce the streams
                     queries = np.asarray(next(self._iter))
                     key = jax.random.fold_in(self._rollout_base, index)
-                    return body(queries, key, tree)
+                    refresh = None
+                    if cfg.rollout_inflight_swaps:
+                        # serial/in-process path: poll the orchestrator's
+                        # weight store directly (no transport hop), seeded
+                        # with the dispatch version the producer pinned
+                        from nanorlhf_tpu.orchestrator.weight_store import (
+                            make_swap_refresh,
+                            store_poll,
+                        )
+
+                        refresh = make_swap_refresh(
+                            store_poll(self._orchestrator.store),
+                            have_version=self._orchestrator.store.version,
+                            faults=self.faults, worker=0,
+                        )
+                    return body(queries, key, tree, None, refresh)
 
                 self._orchestrator = RolloutOrchestrator(
                     dispatch_fn=dispatch,
@@ -1249,6 +1286,10 @@ class RLTrainer:
         # minibatch dict's key set — and the jitted update — never changes
         use_is = self._use_is
         is_truncation = cfg.offpolicy_is_truncation
+        # per-segment IS (rollout_inflight_swaps): same static-key-set
+        # contract — segment_ages is in every minibatch or in none, so the
+        # jitted update never recompiles mid-run
+        use_seg = self._use_seg
 
         combine = self._combine
         sp_on = self._sp_on()
@@ -1321,18 +1362,24 @@ class RLTrainer:
             # behavior (stale sampling policy) logprobs for truncated IS —
             # None keeps every loss in its exact synchronous form
             behavior = mb["behavior_logprobs"] if use_is else None
+            # per-token policy ages (newest version in row − token's
+            # segment version): widens the IS weight into its per-segment
+            # form; None keeps the whole-sequence weight bit-exact
+            seg_ages = mb["segment_ages"] if use_seg else None
 
             if algo == AlgoName.GRPO:
                 loss, aux = grpo_loss(
                     new_logprobs, mb["logprobs"], mb["ref_logprobs"],
                     mb["advantages"], mask, cfg.cliprange, cfg.kl_coef,
                     behavior_logprobs=behavior, is_truncation=is_truncation,
+                    segment_ages=seg_ages,
                 )
             elif algo == AlgoName.RLOO:
                 loss, aux = ppo_clip_loss_sequence(
                     new_logprobs, mb["logprobs"], mb["advantages_seq"], mask,
                     cfg.cliprange,
                     behavior_logprobs=behavior, is_truncation=is_truncation,
+                    segment_ages=seg_ages,
                 )
             elif algo == AlgoName.RAFT:
                 # RAFT's SFT objective has no ratio to correct — best-of-K
@@ -1343,6 +1390,7 @@ class RLTrainer:
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
                     behavior_logprobs=behavior, is_truncation=is_truncation,
+                    segment_ages=seg_ages,
                 )
                 if sp_on:
                     from nanorlhf_tpu.parallel.sp import sp_score_values
@@ -1373,6 +1421,7 @@ class RLTrainer:
                     new_logprobs, mb["logprobs"], mb["advantages"], mask,
                     cfg.cliprange,
                     behavior_logprobs=behavior, is_truncation=is_truncation,
+                    segment_ages=seg_ages,
                 )
             aux["entropy"] = entropy
             return loss, aux
@@ -1675,12 +1724,17 @@ class RLTrainer:
         ctx_menu = shape_menu(self.dataset.input_ids.shape[1], min_value=16) \
             if hasattr(self.dataset, "input_ids") else None
 
-        def rollout_body(queries, gen_key, gen_tree=None, gen_mesh=None):
+        def rollout_body(queries, gen_key, gen_tree=None, gen_mesh=None,
+                         weight_refresh=None):
             """DISPATCH one rollout (async — nothing blocks until fetched).
             `gen_tree` (orchestrated mode) is a published weight-store
             snapshot; None samples from the live params. `gen_mesh` (fleet
             × disaggregation) is the calling worker's own device group;
-            None generates on the shared rollout/train mesh."""
+            None generates on the shared rollout/train mesh.
+            `weight_refresh` (rollout_inflight_swaps) is the store/transport
+            poll callback; raw host snapshots it yields are converted to
+            rollout-ready params here before the decode driver installs
+            them (docs/ORCHESTRATOR.md §in-flight swaps)."""
             if ctx_menu is not None:
                 # r1's de-padding applied to every algorithm: batches of short
                 # prompts roll out / score at a menu-rounded context (warm jit
@@ -1696,6 +1750,16 @@ class RLTrainer:
             queries_j = jax.device_put(jnp.asarray(queries), bs)
             prompt_mask = queries_j != pad_id
             gen_params = self._rollout_params(gen_tree, mesh=gen_mesh)
+            gen_refresh = None
+            if weight_refresh is not None:
+                def gen_refresh():
+                    # device-place a fresh snapshot exactly like the
+                    # dispatch tree so a swap cannot change sharding; a
+                    # (version, None) poll result passes through untouched
+                    version, tree = weight_refresh()
+                    if tree is None:
+                        return version, None
+                    return version, self._rollout_params(tree, mesh=gen_mesh)
             # speculative decode (rollout_spec_k > 0) appends its acceptance
             # counters here — device scalars fetched at metrics time, after
             # the tokens already forced a sync. The tracer hands the spec
@@ -1730,6 +1794,7 @@ class RLTrainer:
                 spec_stats_out=spec_stats, tracer=self.tracer,
                 paged_stats_out=paged_stats, latency=self.latency,
                 prefix_cache=self.prefix_cache,
+                weight_refresh=gen_refresh,
             )                                               # [B*n, T]
             greedy = None
             if self.algo == AlgoName.REMAX:
@@ -1740,9 +1805,18 @@ class RLTrainer:
                     eos_token_id=eos_id, pad_token_id=pad_id,
                     lora_scale=self.lora_scale,
                 )
-            return {"queries": queries, "gen_out": gen_out, "greedy": greedy,
-                    "spec_stats": spec_stats[0] if spec_stats else None,
-                    "paged_stats": paged_stats[0] if paged_stats else None}
+            out = {"queries": queries, "gen_out": gen_out, "greedy": greedy,
+                   "spec_stats": spec_stats[0] if spec_stats else None,
+                   "paged_stats": paged_stats[0] if paged_stats else None}
+            if weight_refresh is not None and paged_stats:
+                # hoist swap provenance to the payload top level: the
+                # lineage ledger (telemetry.segments_summary) and the
+                # per-segment IS batch assembly read it from here
+                ps = paged_stats[0]
+                for k in ("segments", "swap_installs", "swap_wait_s"):
+                    if k in ps:
+                        out[k] = ps[k]
+            return out
 
         from nanorlhf_tpu.orchestrator import ProducerFailed
         from nanorlhf_tpu.resilience import Preempted, ProducerWatchdog
@@ -1903,12 +1977,17 @@ class RLTrainer:
                 # this: generation provenance lands here, once the arrays
                 # are device-ready (policy version == global_step — the same
                 # convention the trace spans use without an orchestrator)
-                from nanorlhf_tpu.telemetry.lineage import spec_summary
+                from nanorlhf_tpu.telemetry.lineage import (
+                    segments_summary,
+                    spec_summary,
+                )
 
                 self.lineage.generation(
                     rollout_index,
                     policy_version=self.state["global_step"], worker_id=0,
                     spec=spec_summary(ro),
+                    segments=segments_summary(ro),
+                    swap_wait_s=ro.get("swap_wait_s"),
                 )
             pstats = ro.get("paged_stats")
             if pstats is not None:
@@ -1953,6 +2032,21 @@ class RLTrainer:
             ]
             question_n = [q for q in question_strings for _ in range(n)]
             responses_np = np.asarray(responses)
+            seg_ages = None
+            if self._use_seg and ro.get("segments") is not None:
+                # per-token policy AGE (newest version that produced any
+                # token of the row, minus the token's own segment version)
+                # in response coordinates — the same [0, total) space the
+                # scheduler's segment tok_ranges tile. Rows untouched by a
+                # swap are all-zero, and zero ages make segment_is_weights
+                # reduce bit-exactly to the whole-sequence weight.
+                seg_ages = np.zeros(responses_np.shape, np.int32)
+                for r, segs in enumerate(ro["segments"]):
+                    newest = max(s["policy_version"] for s in segs)
+                    for s in segs:
+                        lo, hi = s["tok_range"]
+                        if newest > s["policy_version"]:
+                            seg_ages[r, lo:hi] = newest - s["policy_version"]
             responses_decoded = tok.batch_decode(responses_np)
             envp = ro.get("env")
             with self.timer.phase("reward"):
@@ -2022,6 +2116,8 @@ class RLTrainer:
                 responses_np = responses_np.reshape(batch_size, n, -1)[rows, keep]
                 if captured_lp is not None:
                     captured_lp = captured_lp.reshape(batch_size, n, -1)[rows, keep]
+                if seg_ages is not None:
+                    seg_ages = seg_ages.reshape(batch_size, n, -1)[rows, keep]
                 log_scores = log_scores_all.reshape(batch_size, n)[rows, keep]
                 responses_decoded = [
                     responses_decoded[i * n + j] for i, j in enumerate(keep)
@@ -2136,6 +2232,17 @@ class RLTrainer:
                 # The key is only present in env multi-turn runs, so every
                 # other mode compiles the identical jitted update.
                 batch["loss_mask"] = env_loss_mask
+            if seg_ages is not None:
+                # key present only under rollout_inflight_swaps (same
+                # conditional-key pattern as loss_mask above): swaps off
+                # compiles the identical jitted update
+                if keep_inds is not None:
+                    # RLOO/RAFT keep-1-of-N happens below, AFTER batch
+                    # assembly — realign the ages the same way
+                    seg_ages = seg_ages.reshape(batch_size, n, -1)[
+                        np.arange(batch_size), keep_inds
+                    ]
+                batch["segment_ages"] = seg_ages
 
             if keep_inds is not None:
                 # RLOO/RAFT selected 1-of-N *after* the logprob pass; realign
@@ -2339,6 +2446,25 @@ class RLTrainer:
                 metrics["offpolicy/is_trunc_frac_new"] = agg.get(
                     "is_trunc_frac", 0.0
                 )
+            if cfg.rollout_inflight_swaps:
+                # in-flight swap provenance (docs/ORCHESTRATOR.md
+                # §in-flight swaps): installs + the mean number of policy
+                # segments per completion row THIS update consumed (1.0 =
+                # no mid-rollout publish landed), plus the cumulative
+                # install stall this rollout paid (device-put of the fresh
+                # tree at a chunk boundary — the cost drain-and-wait pays
+                # as idle time instead)
+                segs = ro.get("segments")
+                metrics.update({
+                    "rollout/swap_installs": float(
+                        ro.get("swap_installs", 0) or 0),
+                    "rollout/segments_per_sample": (
+                        float(np.mean([len(s) for s in segs]))
+                        if segs else 1.0
+                    ),
+                    "orchestrator/swap_wait_s": float(
+                        ro.get("swap_wait_s", 0.0) or 0.0),
+                })
             # resilience series (docs/RESILIENCE.md): cumulative counters so
             # dashboards diff them into rates; degraded_mode is the sticky
             # sync-fallback flag (0 in healthy pipelined runs)

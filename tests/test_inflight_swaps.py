@@ -1,0 +1,424 @@
+"""In-flight mid-sequence weight swaps (ISSUE 20, docs/ORCHESTRATOR.md
+§in-flight swaps).
+
+Pins the acceptance contract from both ends:
+
+- degenerate cases are AIRTIGHT: per-segment IS weights with a single
+  segment / all-zero ages equal `truncated_is_weights` bit-exactly
+  through the token AND sequence loss paths; a swaps-enabled trainer at
+  staleness 0 (where no mid-rollout publish can exist) reproduces the
+  swaps-off run over BOTH fleet transports (in-process and loopback RPC)
+  with zero installs and exactly one segment per sample;
+- the mechanism is REAL: a forced 2-publish generation stamps >= 2
+  segments on the rows alive at the swap points, every row's segments
+  exactly tile [0, n_generated) with strictly increasing versions, and a
+  >= 2-segment batch's per-segment loss DIFFERS from the whole-sequence
+  clamp (the correction is not a no-op);
+- the plumbing honors its contracts: `_finalize_segments` drops empty
+  spans, `make_swap_refresh` counts versions monotonically through the
+  `swap.stale` delay fault, and the trainer validation rejects swaps
+  without the orchestrator / the queued paged scheduler.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nanorlhf_tpu.algos.losses import (
+    grpo_loss,
+    ppo_clip_loss_sequence,
+    ppo_clip_loss_token,
+    segment_is_weights,
+    truncated_is_weights,
+)
+from nanorlhf_tpu.orchestrator.weight_store import (
+    VersionedWeightStore,
+    make_swap_refresh,
+    store_poll,
+)
+from nanorlhf_tpu.resilience.faults import FaultInjector
+from nanorlhf_tpu.sampler import SamplingParams, generate
+from nanorlhf_tpu.sampler.paged.scheduler import _finalize_segments
+from nanorlhf_tpu.trainer import AlgoName
+
+from test_paged_cache import EOS, PAD, _chain_model, _chain_prompts
+from test_trainer_smoke import make_trainer
+
+STREAM_KEYS = ("eval_objective/scores_old", "objective/entropy_old",
+               "objective/kl_rollout_old")
+
+
+def _metric_rows(outdir):
+    rows = []
+    with open(outdir / "metrics.jsonl") as f:
+        for line in f:
+            row = json.loads(line)
+            if "episode" in row:
+                rows.append(row)
+    return rows
+
+
+# --------------------------------------------------------------------- #
+# per-segment IS: bit-exact degenerate reduction, real multi-segment diff
+# --------------------------------------------------------------------- #
+
+def _logprob_fixture(B=3, T=10, seed=0):
+    rng = np.random.default_rng(seed)
+    new = jnp.asarray(rng.normal(-1.2, 0.5, (B, T)).astype(np.float32))
+    old = jnp.asarray(rng.normal(-1.1, 0.5, (B, T)).astype(np.float32))
+    beh = jnp.asarray(rng.normal(-1.3, 0.5, (B, T)).astype(np.float32))
+    ref = jnp.asarray(rng.normal(-1.0, 0.5, (B, T)).astype(np.float32))
+    adv = jnp.asarray(rng.normal(0.0, 1.0, (B, T)).astype(np.float32))
+    mask = jnp.asarray(rng.random((B, T)) < 0.8)
+    return new, old, beh, ref, adv, mask
+
+
+def test_single_segment_weights_bitexact():
+    """All-zero ages (no swap landed) must reduce BIT-EXACTLY to the
+    whole-sequence truncated-IS weights — not merely allclose."""
+    _, old, beh, _, _, _ = _logprob_fixture()
+    ages = jnp.zeros(old.shape, jnp.int32)
+    w_seg, t_seg = segment_is_weights(old, beh, ages, 2.0)
+    w_who, t_who = truncated_is_weights(old, beh, 2.0)
+    assert np.array_equal(np.asarray(w_seg), np.asarray(w_who))
+    assert np.array_equal(np.asarray(t_seg), np.asarray(t_who))
+
+
+def test_single_segment_losses_bitexact():
+    """segment_ages=zeros vs segment_ages=None through every loss that
+    takes the knob: token PPO-clip, GRPO, and the sequence (RLOO) path —
+    loss AND aux identical to the bit."""
+    new, old, beh, ref, adv, mask = _logprob_fixture()
+    ages = jnp.zeros(old.shape, jnp.int32)
+
+    for fn, args in (
+        (ppo_clip_loss_token, (new, old, adv, mask, 0.2)),
+        (grpo_loss, (new, old, ref, adv, mask, 0.2, 0.05)),
+        (ppo_clip_loss_sequence, (new, old, adv[:, 0], mask, 0.2)),
+    ):
+        base, base_aux = fn(*args, behavior_logprobs=beh, is_truncation=2.0)
+        seg, seg_aux = fn(*args, behavior_logprobs=beh, is_truncation=2.0,
+                          segment_ages=ages)
+        assert np.array_equal(np.asarray(base), np.asarray(seg)), fn.__name__
+        for k in base_aux:
+            assert np.array_equal(
+                np.asarray(base_aux[k]), np.asarray(seg_aux[k])
+            ), f"{fn.__name__} aux {k}"
+
+
+def test_multi_segment_loss_differs_from_whole_sequence():
+    """A >= 2-segment row whose raw ratios exceed the tighter per-segment
+    cap must produce a DIFFERENT loss than the whole-sequence clamp — the
+    correction is real. old − behavior = 1.0 per token → raw ratio
+    e ≈ 2.72 > ρ̄ = 2.0 everywhere; the age-1 segment clamps at
+    ρ̄^(1/2) ≈ 1.414 instead of 2.0."""
+    B, T = 2, 8
+    new = jnp.full((B, T), -1.0, jnp.float32)
+    old = jnp.full((B, T), -1.0, jnp.float32)
+    beh = old - 1.0
+    ref = jnp.full((B, T), -1.1, jnp.float32)
+    adv = jnp.ones((B, T), jnp.float32)
+    mask = jnp.ones((B, T), bool)
+    ages = jnp.asarray(
+        np.repeat([[0, 0, 0, 0, 1, 1, 1, 1]], B, axis=0), jnp.int32)
+
+    w_seg, _ = segment_is_weights(old, beh, ages, 2.0)
+    np.testing.assert_allclose(np.asarray(w_seg[:, :4]), 2.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(w_seg[:, 4:]), 2.0 ** 0.5,
+                               rtol=1e-6)
+
+    for fn, args in (
+        (ppo_clip_loss_token, (new, old, adv, mask, 0.2)),
+        (grpo_loss, (new, old, ref, adv, mask, 0.2, 0.05)),
+        (ppo_clip_loss_sequence, (new, old, adv[:, 0], mask, 0.2)),
+    ):
+        whole, _ = fn(*args, behavior_logprobs=beh, is_truncation=2.0)
+        seg, _ = fn(*args, behavior_logprobs=beh, is_truncation=2.0,
+                    segment_ages=ages)
+        assert float(whole) != float(seg), fn.__name__
+
+
+def test_sequence_segment_weight_factorizes_over_segments():
+    """The sequence path's weight must be the PRODUCT of per-segment
+    clamped sub-ratios (each segment's summed diff clamped at its own
+    ρ̄_a), with pad-tail runs contributing exactly 1."""
+    old = jnp.asarray([[-1.0, -1.5, -0.5, -2.0, -1.0, 0.0]], jnp.float32)
+    beh = jnp.asarray([[-1.2, -1.1, -0.9, -2.1, -1.4, 0.0]], jnp.float32)
+    new = old
+    adv = jnp.ones((1,), jnp.float32)
+    mask = jnp.asarray([[True, True, True, True, True, False]])
+    ages = jnp.asarray([[0, 0, 1, 1, 2, 2]], jnp.int32)
+    rho = 1.5
+
+    _, aux = ppo_clip_loss_sequence(
+        new, old, adv, mask, 0.2, behavior_logprobs=beh,
+        is_truncation=rho, segment_ages=ages)
+    d = np.asarray(old - beh)[0]
+    expected = 1.0
+    for lo, hi, age in ((0, 2, 0), (2, 4, 1), (4, 5, 2)):
+        expected *= min(np.exp(d[lo:hi].sum()), rho ** (1.0 / (1.0 + age)))
+    np.testing.assert_allclose(
+        float(aux["is_weight_mean"]), expected, rtol=1e-5)
+
+
+# --------------------------------------------------------------------- #
+# _finalize_segments: exact tiling, empty spans dropped
+# --------------------------------------------------------------------- #
+
+def test_finalize_segments_tiles_and_drops_empty():
+    # plain 2-swap row
+    assert _finalize_segments([(0, 0), (1, 5), (3, 9)], 12) == [
+        {"policy_version": 0, "tok_range": [0, 5]},
+        {"policy_version": 1, "tok_range": [5, 9]},
+        {"policy_version": 3, "tok_range": [9, 12]},
+    ]
+    # swaps landing before the row's first token AND after it finished:
+    # the empty spans drop, the survivor still tiles [0, total)
+    assert _finalize_segments([(0, 0), (1, 0), (2, 4), (3, 4)], 4) == [
+        {"policy_version": 1, "tok_range": [0, 4]},
+    ]
+    # zero-length generation collapses to one stamped span
+    assert _finalize_segments([(2, 0)], 0) == [
+        {"policy_version": 2, "tok_range": [0, 0]}]
+
+
+# --------------------------------------------------------------------- #
+# make_swap_refresh: base install, monotone versions, swap.stale delay
+# --------------------------------------------------------------------- #
+
+def test_swap_refresh_base_install_and_monotone_versions():
+    store = VersionedWeightStore()
+    refresh = make_swap_refresh(store_poll(store))
+    # unpublished store: nothing to install, no crash
+    v, tree = refresh()
+    assert v < 0 and tree is None
+    store.publish({"w": 0})
+    # have_version=None: the FIRST hit returns latest outright (base
+    # install, uncounted by the caller)
+    v, tree = refresh()
+    assert (v, tree) == (0, {"w": 0})
+    # held version is newest -> None until the next publish
+    assert refresh() == (0, None)
+    store.publish({"w": 1})
+    assert refresh() == (1, {"w": 1})
+
+    # have_version=v seed (fleet workers know their dispatch version):
+    # same-version polls install nothing
+    r2 = make_swap_refresh(store_poll(store), have_version=1)
+    assert r2() == (1, None)
+    store.publish({"w": 2})
+    assert r2() == (2, {"w": 2})
+
+
+def test_swap_stale_fault_delays_but_keeps_versions_increasing():
+    """The swap.stale delay action sleeps then installs the (possibly
+    superseded) tree anyway; the NEXT sync point installs the newer one —
+    installed versions stay strictly increasing."""
+    import time as _time
+
+    store = VersionedWeightStore()
+    store.publish({"w": 0})
+    inj = FaultInjector.from_spec("swap.stale:every=1,delay=0.05,count=1")
+    refresh = make_swap_refresh(store_poll(store), have_version=0,
+                                faults=inj, worker=0)
+    store.publish({"w": 1})
+    t0 = _time.perf_counter()
+    v1, tree1 = refresh()
+    stalled = _time.perf_counter() - t0
+    assert (v1, tree1) == (1, {"w": 1})
+    assert stalled >= 0.05  # the fault really stalled the install
+    # a publish that raced the stall lands at the NEXT poll, version up
+    store.publish({"w": 2})
+    assert refresh() == (2, {"w": 2})
+    assert refresh() == (2, None)
+
+
+# --------------------------------------------------------------------- #
+# forced mid-decode swaps: segments tile, versions increase, bits equal
+# --------------------------------------------------------------------- #
+
+def test_forced_two_swaps_segments_tile_generation():
+    """Two forced publishes mid-decode (same tree, so the greedy stream is
+    bit-identical to the refresh-free run): every queue entry's segments
+    exactly tile [0, n_generated) with strictly increasing versions, the
+    long row alive at both swap points carries 3 segments, and a row
+    admitted after the last swap starts at the newest version."""
+    cfg, params = _chain_model()
+    # greedy lengths 20, 4, 14, 3 (start v -> 31 - v tokens incl. EOS)
+    starts = [11, 27, 17, 28]
+    ids, mask = _chain_prompts(starts)
+    sp = SamplingParams(greedy=True, max_tokens=24, page_size=4,
+                        decode_rows=2)
+
+    calls = {"n": 0, "v": 0}
+
+    def refresh():
+        # call 1 is the scheduler's pre-loop base poll; calls 2 and 3 are
+        # the first two decode-chunk sync points (the host chunk spans
+        # several tokens), with the start-11 and start-17 rows resident
+        calls["n"] += 1
+        if calls["n"] in (2, 3):
+            calls["v"] += 1
+            return calls["v"], params
+        return calls["v"], None
+
+    stats = []
+    out = np.asarray(generate(
+        params, cfg, ids, mask, jax.random.PRNGKey(0), sp,
+        eos_token_id=EOS, pad_token_id=PAD, paged_stats_out=stats,
+        weight_refresh=refresh))
+    ref = np.asarray(generate(
+        params, cfg, ids, mask, jax.random.PRNGKey(0), sp,
+        eos_token_id=EOS, pad_token_id=PAD))
+    np.testing.assert_array_equal(out, ref)
+
+    st = stats[0]
+    assert st["swap_installs"] == 2
+    assert st["swap_wait_s"] >= 0.0
+    segments = st["segments"]
+    assert len(segments) == len(starts)
+    for q, segs in enumerate(segments):
+        n_gen = int(np.sum(out[q] != PAD))
+        # exact tiling of [0, n_generated)
+        assert segs[0]["tok_range"][0] == 0
+        assert segs[-1]["tok_range"][1] == n_gen
+        for a, b in zip(segs, segs[1:]):
+            assert a["tok_range"][1] == b["tok_range"][0]
+        for s in segs:
+            assert s["tok_range"][1] > s["tok_range"][0]
+        versions = [s["policy_version"] for s in segs]
+        assert versions == sorted(set(versions)), versions  # strictly inc
+    # the 20-token row rode through both installs
+    assert len(segments[0]) == 3
+    assert [s["policy_version"] for s in segments[0]] == [0, 1, 2]
+    assert sum(1 for segs in segments if len(segs) >= 2) >= 2
+    # the last-admitted short row started life on the newest weights
+    assert segments[3] == [{"policy_version": 2, "tok_range": [
+        0, int(np.sum(out[3] != PAD))]}]
+
+
+# --------------------------------------------------------------------- #
+# multi-turn env driver: swaps at re-admission, silent poll bit-identical
+# --------------------------------------------------------------------- #
+
+def _run_env(refresh, greedy=True):
+    from nanorlhf_tpu.envs.rollout import run_env_episodes
+    from test_envs import EchoEnv, _driver_prompts, _tiny_model, text_reward
+
+    tok, mcfg, params = _tiny_model()
+    ids, mask = _driver_prompts(tok, 2, 8)
+    env = EchoEnv(text_reward, max_turns=2)
+    env.eos_token = tok.eos_token
+    sampling = SamplingParams(max_tokens=12, temperature=1.0, n=2,
+                              greedy=greedy)
+    try:
+        return params, run_env_episodes(
+            params, mcfg, ids, mask, jax.random.PRNGKey(7), sampling, env,
+            eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+            tokenizer=tok, max_turns=2, turn_tokens=12, obs_budget=8,
+            response_length=40, page_size=4, decode_rows=2,
+            weight_refresh=refresh,
+        )
+    finally:
+        env.close()
+
+
+def test_env_driver_silent_poll_bit_identical_and_swap_segments():
+    """The multi-turn episode driver honors the same contract: a refresh
+    that never reports a newer version leaves the packed episode streams
+    bit-identical to weight_refresh=None (single segment per episode),
+    and one that publishes after turn 1 stamps a second segment at the
+    re-admission boundary in packed response-token coordinates — the
+    coordinate space the `turns` records share."""
+    _, base = _run_env(None)
+    _, silent = _run_env(lambda: (0, None))
+    np.testing.assert_array_equal(base["tokens"], silent["tokens"])
+    assert silent["swap_installs"] == 0
+    assert all(len(s) == 1 for s in silent["segments"])
+
+    calls = {"n": 0}
+
+    def hot():
+        # call 1 = base install; call 2 lands at the first main-loop sync,
+        # after turn 1 but with continuation turns still to decode
+        calls["n"] += 1
+        if calls["n"] == 2:
+            return 1, hot.params
+        return min(calls["n"] - 1, 1), None
+
+    # bind after _run_env hands us params (same tree -> same tokens)
+    from test_envs import _tiny_model
+    hot.params = _tiny_model()[2]
+    params, out = _run_env(hot)
+    np.testing.assert_array_equal(base["tokens"], out["tokens"])
+    assert out["swap_installs"] == 1
+    assert len(out["segments"]) == base["tokens"].shape[0]
+    multi = [s for s in out["segments"] if len(s) >= 2]
+    assert multi, out["segments"]
+    for segs in out["segments"]:
+        for a, b in zip(segs, segs[1:]):
+            assert a["tok_range"][1] == b["tok_range"][0]
+            assert b["policy_version"] > a["policy_version"]
+
+
+# --------------------------------------------------------------------- #
+# trainer: swaps-on at staleness 0 is bit-identical to swaps-off, both
+# transports; validation rejects unsupported compositions
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def paged_fleet_rows(tmp_path_factory):
+    """Baseline: orchestrated 2-worker fleet over the queued paged rollout
+    path, swaps OFF."""
+    out = tmp_path_factory.mktemp("swapbase")
+    tr = make_trainer(AlgoName.GRPO, out, total_episodes=32, save_steps=0,
+                      rollout_orchestrator=True, rollout_workers=2,
+                      max_staleness=0, rollout_page_size=4,
+                      rollout_decode_rows=4)
+    tr.train()
+    tr.close()
+    return _metric_rows(out / "grpo")
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "rpc"])
+def test_swaps_on_staleness0_bit_identical(tmp_path, paged_fleet_rows,
+                                           transport):
+    """rollout_inflight_swaps=True at max_staleness=0: no publish can land
+    mid-rollout (the producer gate serializes publish → dispatch), so the
+    poll returns None at every chunk and the run must reproduce the
+    swaps-off stream over BOTH transports — with the swap metrics rows
+    present, zero installs, and exactly one segment per sample."""
+    tr = make_trainer(AlgoName.GRPO, tmp_path, total_episodes=32,
+                      save_steps=0, rollout_orchestrator=True,
+                      rollout_workers=2, max_staleness=0,
+                      rollout_page_size=4, rollout_decode_rows=4,
+                      rollout_transport=transport,
+                      rollout_inflight_swaps=True)
+    tr.train()
+    tr.close()
+    rows = _metric_rows(tmp_path / "grpo")
+    assert len(rows) == len(paged_fleet_rows) == 2
+    for a, b in zip(paged_fleet_rows, rows):
+        for key in STREAM_KEYS + ("loss/policy_avg_new",):
+            np.testing.assert_allclose(
+                a[key], b[key], rtol=1e-5,
+                err_msg=f"{transport}: swaps-on staleness-0 {key} "
+                        f"diverged from swaps-off",
+            )
+    for row in rows:
+        assert row["rollout/swap_installs"] == 0.0
+        assert row["rollout/segments_per_sample"] == 1.0
+        assert row["orchestrator/swap_wait_s"] == 0.0
+
+
+def test_swaps_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="rollout_orchestrator"):
+        make_trainer(AlgoName.GRPO, tmp_path / "a",
+                     rollout_inflight_swaps=True)
+    with pytest.raises(ValueError, match="rollout_page_size"):
+        make_trainer(AlgoName.GRPO, tmp_path / "b",
+                     rollout_orchestrator=True, rollout_inflight_swaps=True)

@@ -24,6 +24,14 @@ scores from `sample`/`reward` events the ledger already carries).
                                                       # tool wall, observation
                                                       # lengths, per-turn
                                                       # reward
+  python tools/inspect_run.py RUN_DIR --segments      # per-sample weight-
+                                                      # version segment
+                                                      # timelines (in-flight
+                                                      # swap runs): spans,
+                                                      # swaps/sample, tokens
+                                                      # per policy version,
+                                                      # install wait, joined
+                                                      # to `turn` spans
   python tools/inspect_run.py statusz.json --serving  # serving engine +
                                                       # radix prefix-cache
                                                       # sections of a saved
@@ -120,6 +128,106 @@ def turns_report(events) -> dict:
         })
     tpe = (sum(e["turns"] for e in out) / len(out)) if out else 0.0
     return {"episodes": out, "turns_per_episode": tpe}
+
+
+def segments_report(events) -> dict:
+    """Reconstruct per-sample weight-version timelines from `generation`
+    events' `segments` lists ALONE (docs/ORCHESTRATOR.md §in-flight
+    swaps) — the offline mirror of `rollout/segments_per_sample` /
+    `rollout/swap_installs`. One entry per (rollout_index, row) sample:
+    its ordered `{policy_version, tok_range}` spans, swap count
+    (len(segments) − 1), and the row's version spread. `tok_range` is in
+    response-token coordinates — the SAME space as multi-turn `turn`
+    events' spans, so each sample also carries the turns that overlap
+    it when the run was multi-turn. Aggregates: segments/sample, total
+    swaps, tokens decoded under each policy version (spans with an
+    unknown end — the no-swap default stamp — are excluded from token
+    totals), and swap-install latency from `swap_wait_s` when the
+    payload carried it."""
+    turns: dict = {}
+    for ev in events:
+        if ev.get("type") == "turn":
+            turns.setdefault((ev.get("rollout_index"), ev.get("row")),
+                             []).append(ev)
+    samples: dict = {}
+    waits = []
+    for ev in events:
+        if ev.get("type") != "generation":
+            continue
+        if isinstance(ev.get("swap_wait_s"), (int, float)):
+            waits.append(float(ev["swap_wait_s"]))
+        for seg in ev.get("segments") or []:
+            key = (ev.get("rollout_index"), seg.get("row"))
+            samples.setdefault(key, []).append(seg)
+    out, tokens_by_version = [], {}
+    for (idx, row), segs in sorted(
+            samples.items(),
+            key=lambda kv: (kv[0][0] or 0, kv[0][1] or 0)):
+        segs.sort(key=lambda s: (s.get("tok_range") or [0, 0])[0])
+        versions = [s.get("policy_version") for s in segs
+                    if s.get("policy_version") is not None]
+        for s in segs:
+            lo, hi = (s.get("tok_range") or [None, None])
+            if (s.get("policy_version") is not None
+                    and isinstance(lo, int) and isinstance(hi, int)):
+                tokens_by_version[s["policy_version"]] = (
+                    tokens_by_version.get(s["policy_version"], 0)
+                    + max(0, hi - lo))
+        entry = {
+            "rollout_index": idx,
+            "row": row,
+            "segments": [{"policy_version": s.get("policy_version"),
+                          "tok_range": s.get("tok_range")} for s in segs],
+            "swaps": max(0, len(segs) - 1),
+            "version_spread": (max(versions) - min(versions)
+                               if versions else 0),
+        }
+        tevs = turns.get((idx, row))
+        if tevs:
+            entry["turn_tok_ranges"] = [
+                e.get("tok_range")
+                for e in sorted(tevs, key=lambda e: e.get("turn", 0))]
+        out.append(entry)
+    n = len(out)
+    return {
+        "samples": out,
+        "segments_per_sample": (
+            sum(len(s["segments"]) for s in out) / n if n else 0.0),
+        "swaps_total": sum(s["swaps"] for s in out),
+        "rows_multi_segment": sum(1 for s in out if s["swaps"] > 0),
+        "tokens_by_version": {
+            str(v): t for v, t in sorted(tokens_by_version.items())},
+        "swap_wait_s": percentiles_from_samples(waits) if waits else None,
+    }
+
+
+def _print_segments(rep: dict) -> None:
+    smp = rep["samples"]
+    if not smp:
+        print("no `generation` events with segments in the ledger "
+              "(lineage off, or a pre-swap-era run)")
+        return
+    print(f"{len(smp)} samples, "
+          f"{rep['segments_per_sample']:.2f} segments/sample, "
+          f"{rep['swaps_total']} swaps "
+          f"({rep['rows_multi_segment']} multi-segment rows)")
+    if rep["tokens_by_version"]:
+        tv = ", ".join(f"v{v}: {t}"
+                       for v, t in rep["tokens_by_version"].items())
+        print(f"  tokens by policy version: {tv}")
+    if rep["swap_wait_s"] and rep["swap_wait_s"].get("count"):
+        p = rep["swap_wait_s"]
+        print(f"  swap install wait: p50 {p['p50_s']:.4f}s "
+              f"p95 {p['p95_s']:.4f}s over {p['count']} rollouts")
+    for s in smp:
+        spans = ", ".join(
+            f"v{g['policy_version']}@{g['tok_range']}"
+            for g in s["segments"])
+        line = (f"  rollout {s['rollout_index']} row {s['row']}: "
+                f"{len(s['segments'])} seg [{spans}]")
+        if s.get("turn_tok_ranges"):
+            line += f" turns {s['turn_tok_ranges']}"
+        print(line)
 
 
 def traffic_report(events) -> dict:
@@ -474,6 +582,11 @@ def main():
                     help="per-episode turn timelines from `turn` events "
                          "(multi-turn env runs): turn count, tool wall, "
                          "observation lengths, per-turn reward")
+    ap.add_argument("--segments", action="store_true",
+                    help="per-sample weight-version segment timelines from "
+                         "`generation` events' segments lists (in-flight "
+                         "swap runs), joined to `turn` events on the shared "
+                         "response-token coordinates")
     ap.add_argument("--traffic", action="store_true",
                     help="offered-load/goodput/shed timeline + autoscale "
                          "decisions reconstructed from `traffic`/"
@@ -539,6 +652,14 @@ def main():
                   f"p50={summ['p50_s']:.4f}s p95={summ['p95_s']:.4f}s "
                   f"p99={summ['p99_s']:.4f}s "
                   f"mean={summ['mean_s']:.4f}s max={summ['max_s']:.4f}s")
+        return 0
+
+    if args.segments:
+        rep = segments_report(events)
+        if args.json:
+            print(json.dumps(rep, sort_keys=True))
+            return 0
+        _print_segments(rep)
         return 0
 
     if args.traffic:
